@@ -1,0 +1,41 @@
+"""Shared constants for accelerate_tpu.
+
+Capability parity: reference `src/accelerate/utils/constants.py` (checkpoint file
+names, option lists). Values here are TPU-native (orbax/msgpack layouts instead of
+torch .bin/.safetensors) but serve the same roles.
+"""
+
+# Checkpoint layout (see checkpointing.py)
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_STATE_NAME = "dataloader"
+RNG_STATE_NAME = "rng_state"
+CUSTOM_STATE_NAME = "custom_checkpoint"
+STEP_STATE_NAME = "step"
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+# Profile trace filename pattern (one per host), mirrors reference PROFILE_PATTERN_NAME
+PROFILE_PATTERN_NAME = "profile_{suffix}"
+
+# Mesh axis names, ordered outermost (slowest, DCN-friendly) to innermost (ICI-friendly).
+# data: pure data parallel replicas
+# fsdp: parameter/optimizer-state sharding axis (ZeRO-3 analogue)
+# tensor: tensor (Megatron-style) model parallelism
+# sequence: sequence/context parallelism (ring attention)
+# stage: pipeline stages
+MESH_AXIS_NAMES = ("data", "fsdp", "stage", "sequence", "tensor")
+
+# Environment variable namespace (launcher <-> library contract)
+ENV_PREFIX = "ACCELERATE_TPU_"
+
+# Default config file location
+DEFAULT_CONFIG_DIR_ENV = "ACCELERATE_TPU_CONFIG_DIR"
+DEFAULT_CONFIG_NAME = "default_config.yaml"
+
+# Scheduler/optimizer semantics
+FSDP_STATE_DICT_TYPES = ["FULL_STATE_DICT", "SHARDED_STATE_DICT"]
+
+# Mixed-precision choices
+MIXED_PRECISION_CHOICES = ["no", "bf16", "fp16", "fp8"]

@@ -1,0 +1,87 @@
+"""Environment-variable parsing and host introspection.
+
+Capability parity: reference `src/accelerate/utils/environment.py` (str_to_bool,
+parse_flag_from_env, CPU topology probing). TPU-native: the launcher <-> library
+contract uses ``ACCELERATE_TPU_*`` variables plus JAX's own coordinator variables
+(``JAX_COORDINATOR_ADDRESS``/``JAX_PROCESS_ID``/``JAX_NUM_PROCESSES``) instead of
+torch.distributed's ``RANK``/``WORLD_SIZE``/``MASTER_ADDR`` rendezvous contract.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a truthy/falsy string to 1/0 (raises on anything else)."""
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, None)
+    if value is None:
+        return default
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        raise ValueError(f"If set, {key} must be yes or no, got {value!r}.")
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_int_from_env(keys: list[str], default: int) -> int:
+    """Return the first set integer among ``keys`` (reference: same helper for PMI/OMPI)."""
+    for key in keys:
+        value = os.environ.get(key, None)
+        if value is not None:
+            return int(value)
+    return default
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set environment variables inside the context, restoring after.
+
+    Mirrors reference `utils/other.py:patch_environment`. Keys are upper-cased.
+    """
+    existing: dict[str, str] = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+@contextlib.contextmanager
+def clear_environment():
+    """Temporarily empty os.environ inside the context (reference `utils/other.py:clear_environment`)."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+def are_we_under_multihost_env() -> bool:
+    """True when launcher-provided multi-host coordinates are present."""
+    return "JAX_COORDINATOR_ADDRESS" in os.environ or "ACCELERATE_TPU_NUM_PROCESSES" in os.environ

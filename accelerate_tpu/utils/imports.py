@@ -1,0 +1,83 @@
+"""Feature-availability probes.
+
+Capability parity: reference `src/accelerate/utils/imports.py` (~50 ``is_*_available``
+probes). The TPU-native build needs far fewer: the compute stack is always JAX; the
+optional pieces are trackers, torch interop, and checkpoint backends.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache
+def _package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def is_torch_available() -> bool:
+    return _package_available("torch")
+
+
+def is_tensorboard_available() -> bool:
+    return _package_available("tensorboardX") or _package_available("tensorboard")
+
+
+def is_wandb_available() -> bool:
+    return _package_available("wandb")
+
+
+def is_mlflow_available() -> bool:
+    return _package_available("mlflow")
+
+
+def is_comet_ml_available() -> bool:
+    return _package_available("comet_ml")
+
+
+def is_clearml_available() -> bool:
+    return _package_available("clearml")
+
+
+def is_aim_available() -> bool:
+    return _package_available("aim")
+
+
+def is_dvclive_available() -> bool:
+    return _package_available("dvclive")
+
+
+def is_orbax_available() -> bool:
+    return _package_available("orbax")
+
+
+def is_transformers_available() -> bool:
+    return _package_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return _package_available("datasets")
+
+
+def is_rich_available() -> bool:
+    return _package_available("rich")
+
+
+def is_tqdm_available() -> bool:
+    return _package_available("tqdm")
+
+
+def is_pandas_available() -> bool:
+    return _package_available("pandas")
+
+
+@lru_cache
+def is_tpu_available() -> bool:
+    """True when the default JAX backend exposes TPU devices."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except RuntimeError:
+        return False

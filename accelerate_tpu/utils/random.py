@@ -1,0 +1,87 @@
+"""Seeding and cross-process RNG synchronization.
+
+Capability parity: reference `src/accelerate/utils/random.py` (set_seed,
+synchronize_rng_states). TPU-native: JAX randomness is an explicit threefry key, so
+the framework keeps one root key per job (split per step/host as needed) instead of
+mutating hidden per-device generator state. Host-side RNG (python/numpy, used by
+samplers and data augmentation) is synchronized by broadcasting from process 0 over
+DCN, mirroring reference `random.py:66-128`.
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+
+from ..state import PartialState
+from .operations import broadcast_object_list
+
+_ROOT_KEY: jax.Array | None = None
+
+
+def set_seed(seed: int, device_specific: bool = False) -> None:
+    """Seed python, numpy and the framework's root JAX key (reference `random.py:31`).
+
+    With ``device_specific`` each process offsets the seed by its index so
+    augmentation streams differ per host while remaining deterministic.
+    """
+    global _ROOT_KEY
+    if device_specific:
+        seed += PartialState().process_index
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    _ROOT_KEY = jax.random.key(seed)
+
+
+def get_rng_key() -> jax.Array:
+    """The job's current root PRNG key (auto-seeded to 0 if set_seed never ran)."""
+    global _ROOT_KEY
+    if _ROOT_KEY is None:
+        _ROOT_KEY = jax.random.key(0)
+    return _ROOT_KEY
+
+
+def split_rng_key(num: int = 2) -> tuple[jax.Array, ...]:
+    """Split the root key, advancing it (functional analogue of generator state)."""
+    global _ROOT_KEY
+    keys = jax.random.split(get_rng_key(), num + 1)
+    _ROOT_KEY = keys[0]
+    return tuple(keys[1:])
+
+
+def capture_rng_state() -> dict[str, Any]:
+    """Snapshot all host+framework RNG state for checkpointing
+    (reference `checkpointing.py:144-161`)."""
+    key = get_rng_key()
+    return {
+        "python": _py_random.getstate(),
+        "numpy": np.random.get_state(),
+        "jax_key_data": np.asarray(jax.random.key_data(key)),
+    }
+
+
+def restore_rng_state(state: dict[str, Any]) -> None:
+    global _ROOT_KEY
+    _py_random.setstate(state["python"])
+    np.random.set_state(state["numpy"])
+    _ROOT_KEY = jax.random.wrap_key_data(np.asarray(state["jax_key_data"]))
+
+
+def synchronize_rng_state() -> None:
+    """Broadcast process 0's host RNG state to all processes so samplers shuffle
+    identically everywhere (reference `random.py:66-128`)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return
+    payload = [capture_rng_state()]
+    broadcast_object_list(payload, from_process=0)
+    restore_rng_state(payload[0])
+
+
+def synchronize_rng_states(rng_types: Iterable[str] | None = None) -> None:
+    """API-compatible alias (the reference takes a list of generator types; here all
+    host RNG state travels together)."""
+    synchronize_rng_state()

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device CPU mesh so every sharding/collective path
+runs without TPU hardware (the reference's "multi-node without a cluster" tier —
+SURVEY.md §4 tier 3 — realized natively via XLA host-platform device multiplexing).
+
+Must run before any jax import, hence module-level os.environ mutation in conftest.
+"""
+
+import os
+
+# jax may already be imported by a sitecustomize that registers a TPU plugin, so
+# env vars alone are not enough: XLA_FLAGS must be set before the CPU client
+# initializes, and the platform override must go through jax.config.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_singletons():
+    """Reset state singletons between tests (reference `AccelerateTestCase.tearDown`
+    → `AcceleratorState._reset_state()`, `test_utils/testing.py:479-490`)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()

@@ -1,0 +1,118 @@
+"""Tests for the host-level pytree collectives (L1) — reference `tests/test_utils.py`
+pytree-op coverage plus `test_utils/scripts/test_ops.py` semantics on one process."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu.utils.operations import (
+    ConvertOutputsToFp32,
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    get_data_structure,
+    listify,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+
+
+def test_recursively_apply_nested():
+    data = {"a": np.ones((2,)), "b": [np.zeros((3,)), {"c": np.full((1,), 5.0)}], "d": "keep"}
+    out = recursively_apply(lambda t: t + 1, data)
+    np.testing.assert_array_equal(out["a"], np.full((2,), 2.0))
+    np.testing.assert_array_equal(out["b"][1]["c"], np.full((1,), 6.0))
+    assert out["d"] == "keep"
+
+
+def test_recursively_apply_namedtuple():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", ["x", "y"])
+    p = Point(np.ones(2), np.zeros(2))
+    out = recursively_apply(lambda t: t + 1, p)
+    assert isinstance(out, Point)
+    np.testing.assert_array_equal(out.x, np.full(2, 2.0))
+
+
+def test_send_to_device():
+    data = {"a": np.arange(4.0), "s": "str"}
+    out = send_to_device(data, jax.devices()[0])
+    assert isinstance(out["a"], jax.Array)
+    assert out["s"] == "str"
+
+
+def test_gather_sharded_global_array():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = jax.make_mesh((8,), ("data",))
+    x = jnp.arange(16.0).reshape(8, 2)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    out = gather(xs)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0).reshape(8, 2))
+
+
+def test_gather_object_single_process():
+    assert gather_object([1, "a"]) == [1, "a"]
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((3, 2))
+    out = pad_across_processes(x, dim=0)
+    assert out.shape == (3, 2)
+
+
+def test_pad_input_tensors():
+    x = np.arange(10).reshape(5, 2)
+    out = pad_input_tensors(x, batch_size=5, num_processes=4)
+    assert out.shape == (8, 2)
+    np.testing.assert_array_equal(out[5], x[4])
+    np.testing.assert_array_equal(out[7], x[4])
+
+
+def test_reduce_and_scale():
+    x = np.full((2,), 4.0)
+    np.testing.assert_array_equal(reduce(x, "mean", scale=0.5), np.full((2,), 2.0))
+
+
+def test_concatenate_and_slice():
+    data = [{"x": np.ones((2, 3))}, {"x": np.zeros((2, 3))}]
+    cat = concatenate(data)
+    assert cat["x"].shape == (4, 3)
+    sliced = slice_tensors(cat, slice(0, 2))
+    np.testing.assert_array_equal(sliced["x"], np.ones((2, 3)))
+
+
+def test_convert_to_fp32():
+    data = {"h": jnp.ones((2,), dtype=jnp.bfloat16), "i": jnp.ones((2,), dtype=jnp.int32)}
+    out = convert_to_fp32(data)
+    assert out["h"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32
+
+
+def test_convert_outputs_wrapper_pickles():
+    import pickle
+
+    def forward(x):
+        return x.astype(jnp.bfloat16)
+
+    wrapped = ConvertOutputsToFp32(forward)
+    out = wrapped(jnp.ones((2,)))
+    assert out.dtype == jnp.float32
+    pickle.loads(pickle.dumps(ConvertOutputsToFp32(len)))
+
+
+def test_find_batch_size_and_listify():
+    assert find_batch_size({"a": [np.zeros((7, 2))]}) == 7
+    assert listify({"a": np.array([1, 2])}) == {"a": [1, 2]}
+
+
+def test_get_data_structure():
+    s = get_data_structure({"a": np.zeros((2, 3), dtype=np.float32)})
+    assert s == {"a": ((2, 3), "float32")}

@@ -1,0 +1,81 @@
+"""Tests for PartialState / AcceleratorState / GradientState (L0)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.parallel.mesh import ParallelismConfig, build_mesh
+from accelerate_tpu.state import AcceleratorState, DistributedType, GradientState, PartialState
+
+
+def test_partial_state_topology():
+    state = PartialState()
+    assert state.num_devices == 8
+    assert state.num_processes == 1
+    assert state.is_main_process
+    assert state.is_last_process
+    assert state.distributed_type == DistributedType.SPMD
+    assert state.use_distributed
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_rank_gated_decorators(capsys):
+    state = PartialState()
+    called = []
+
+    @state.on_main_process
+    def fn():
+        called.append(1)
+
+    fn()
+    assert called == [1]
+    state.print("hello")
+    assert "hello" in capsys.readouterr().out
+
+
+def test_accelerator_state_default_mesh():
+    state = AcceleratorState()
+    assert dict(state.mesh.shape) == {"data": 8, "fsdp": 1, "stage": 1, "sequence": 1, "tensor": 1}
+    assert state.data_parallel_size == 8
+
+
+def test_accelerator_state_custom_mesh():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(data_parallel_size=2, tensor_size=4))
+    assert state.mesh.shape["data"] == 2
+    assert state.mesh.shape["tensor"] == 4
+
+
+def test_mesh_inference_and_validation():
+    cfg = ParallelismConfig(data_parallel_size=-1, tensor_size=2)
+    mesh = build_mesh(cfg, jax.devices())
+    assert mesh.shape["data"] == 4
+    with pytest.raises(ValueError):
+        build_mesh(ParallelismConfig(data_parallel_size=3, tensor_size=2), jax.devices())
+
+
+def test_gradient_state():
+    gs = GradientState(gradient_accumulation_steps=4)
+    assert gs.num_steps == 4
+    assert gs.sync_gradients
+    assert not gs.in_dataloader
+    assert gs.remainder == -1
+    gs2 = GradientState()
+    assert gs2.num_steps == 4  # singleton
+
+
+def test_split_between_processes_dict():
+    state = PartialState()
+    data = {"x": np.arange(6), "y": np.arange(6) * 2}
+    with state.split_between_processes(data) as piece:
+        np.testing.assert_array_equal(piece["x"], np.arange(6))
