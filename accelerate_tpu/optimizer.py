@@ -1,0 +1,158 @@
+"""Optimizer wrapper.
+
+Capability parity: reference `src/accelerate/optimizer.py` (205 LoC) —
+`AcceleratedOptimizer`: skip `step`/`zero_grad` while accumulating, fp16
+skipped-step detection, device placement of optimizer state.
+
+TPU-native re-founding: wraps an optax `GradientTransformation` instead of a torch
+optimizer. Gradients arrive from `Accelerator.backward` already accumulated into a
+buffer on this wrapper; `step()` runs one jitted, donated
+``(params, opt_state, grads) -> (params, opt_state)`` update, sharded like the
+params (ZeRO-style sharded optimizer state falls out of the params' shardings —
+no hand-written partitioned update as in DeepSpeed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .state import AcceleratorState, GradientState
+from .utils.precision import DynamicGradScaler, GradScalerState
+
+
+class AcceleratedOptimizer:
+    def __init__(
+        self,
+        optimizer: optax.GradientTransformation,
+        model: Any = None,
+        scaler: DynamicGradScaler | None = None,
+        opt_state_sharding: Any = None,
+    ):
+        if isinstance(optimizer, AcceleratedOptimizer):
+            raise ValueError("Optimizer is already prepared.")
+        self.optimizer = optimizer  # the optax transformation
+        self.model = model  # PreparedModel holding the master params
+        self.scaler = scaler
+        self.scaler_state: GradScalerState | None = scaler.init() if scaler is not None else None
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState()
+        self.opt_state = None
+        self._opt_state_sharding = opt_state_sharding
+        self._acc_grads = None  # accumulated gradient buffer (pytree like params)
+        self._step_fn: Callable | None = None
+        self._accumulate_fn: Callable | None = None
+        self.step_was_skipped = False
+        self._num_updates = 0
+        if model is not None:
+            self._init_state()
+
+    # ----------------------------------------------------------------- setup
+    def attach_model(self, model: Any) -> None:
+        self.model = model
+        self._init_state()
+
+    def _init_state(self) -> None:
+        """Initialize optax state on-device; jit propagates the params' shardings
+        into the param-shaped state leaves (mu/nu land sharded exactly like their
+        params — the ZeRO property, for free)."""
+        init = jax.jit(self.optimizer.init)
+        self.opt_state = init(self.model.params)
+
+    # ------------------------------------------------------- grad accumulation
+    def _ensure_jits(self) -> None:
+        if self._accumulate_fn is not None:
+            return
+
+        @jax.jit
+        def _add(acc, grads):
+            return jax.tree.map(jnp.add, acc, grads)
+
+        def _apply(params, opt_state, grads):
+            updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state
+
+        self._accumulate_fn = _add
+        self._step_fn = jax.jit(_apply, donate_argnums=(0, 1))
+
+    def accumulate_grads(self, grads: Any) -> None:
+        """Add a (already 1/k-scaled) microbatch gradient into the buffer."""
+        self._ensure_jits()
+        if self._acc_grads is None:
+            self._acc_grads = grads
+        else:
+            self._acc_grads = self._accumulate_fn(self._acc_grads, grads)
+
+    @property
+    def gradients(self) -> Any:
+        return self._acc_grads
+
+    @gradients.setter
+    def gradients(self, value: Any) -> None:
+        self._acc_grads = value
+
+    # ------------------------------------------------------------------ torch-y API
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        """Clear the accumulation buffer — a no-op while accumulating
+        (reference `optimizer.py:111-121`)."""
+        if self.gradient_state.sync_gradients:
+            self._acc_grads = None
+
+    def step(self, closure: Callable | None = None) -> None:
+        """Apply the buffered gradient — a no-op while accumulating
+        (reference `optimizer.py:154`). With fp16, unscale first and skip the
+        update entirely on overflow (reference `:154-169`)."""
+        if not self.gradient_state.sync_gradients:
+            self.step_was_skipped = False
+            return
+        if self._acc_grads is None:
+            raise RuntimeError("optimizer.step() called with no gradients; call accelerator.backward first.")
+        self._ensure_jits()
+        grads = self._acc_grads
+        if self.scaler is not None:
+            grads, self.scaler_state, finite = self.scaler.unscale_and_update(grads, self.scaler_state)
+            if not bool(finite):
+                self.step_was_skipped = True
+                self._acc_grads = None
+                return
+        new_params, self.opt_state = self._step_fn(self.model.params, self.opt_state, grads)
+        self.model.params = new_params
+        self.step_was_skipped = False
+        self._num_updates += 1
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def num_updates(self) -> int:
+        return self._num_updates
+
+    @property
+    def learning_rate(self) -> float | None:
+        """Current LR if the optax state exposes one (inject_hyperparams or
+        scale_by_schedule patterns)."""
+        def _find(state):
+            if hasattr(state, "hyperparams") and "learning_rate" in state.hyperparams:
+                return float(state.hyperparams["learning_rate"])
+            return None
+
+        for leaf in jax.tree.leaves(self.opt_state, is_leaf=lambda x: hasattr(x, "hyperparams")):
+            lr = _find(leaf)
+            if lr is not None:
+                return lr
+        return None
+
+    # ------------------------------------------------------------ checkpointing
+    def state_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"opt_state": self.opt_state, "num_updates": self._num_updates}
+        if self.scaler_state is not None:
+            out["scaler_state"] = self.scaler_state
+        return out
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.opt_state = state["opt_state"]
+        self._num_updates = int(state.get("num_updates", 0))
+        if "scaler_state" in state and self.scaler is not None:
+            self.scaler_state = GradScalerState(*state["scaler_state"])

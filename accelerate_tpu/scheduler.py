@@ -1,0 +1,81 @@
+"""Learning-rate scheduler wrapper.
+
+Capability parity: reference `src/accelerate/scheduler.py` (98 LoC) —
+`AcceleratedScheduler` steps the wrapped scheduler only when gradients actually
+synced, and not when the fp16 optimizer skipped its step.
+
+TPU-native note: with one jitted SPMD step consuming the *global* batch, one
+optimizer update corresponds to one scheduler step (the reference's
+"step num_processes times" compensation exists only because its per-rank loops
+each see 1/P of the data; that situation cannot arise here — equivalent to the
+reference with ``split_batches=True``).
+
+Works with (a) `OptaxSchedule` below, (b) any object exposing ``step()`` (torch
+LR schedulers duck-type). optax optimizers whose transformation embeds a schedule
+advance automatically with each update and need no wrapper at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .state import GradientState
+
+
+class OptaxSchedule:
+    """Adapter giving an optax schedule function a torch-scheduler-shaped API
+    (``step()`` / ``get_last_lr()`` / ``state_dict()``)."""
+
+    def __init__(self, schedule_fn: Callable[[int], float]):
+        self.schedule_fn = schedule_fn
+        self.count = 0
+
+    def step(self) -> None:
+        self.count += 1
+
+    def get_last_lr(self) -> list[float]:
+        return [float(self.schedule_fn(self.count))]
+
+    def state_dict(self) -> dict[str, Any]:
+        return {"count": self.count}
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.count = int(state["count"])
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler: Any,
+        optimizers: list | None = None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers or []
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+
+    def step(self, *args: Any, **kwargs: Any) -> None:
+        if not self.step_with_optimizer:
+            self.scheduler.step(*args, **kwargs)
+            return
+        if not self.gradient_state.sync_gradients:
+            return
+        # don't advance past a skipped (overflowed) fp16 step — reference `scheduler.py:54-82`
+        if any(getattr(opt, "step_was_skipped", False) for opt in self.optimizers):
+            return
+        self.scheduler.step(*args, **kwargs)
+
+    def get_last_lr(self) -> list[float]:
+        return self.scheduler.get_last_lr()
+
+    def state_dict(self) -> dict[str, Any]:
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.scheduler.load_state_dict(state)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.scheduler, name)

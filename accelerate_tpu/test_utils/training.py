@@ -1,0 +1,49 @@
+"""Toy training fixtures (reference `test_utils/training.py` — RegressionDataset /
+RegressionModel: linear y = a·x + b used by every parity test)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    """Map-style dataset of (x, y=a*x+b+noise) pairs, torch-DataLoader compatible."""
+
+    def __init__(self, a: float = 2.0, b: float = 3.0, length: int = 64, seed: int = 42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.05 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, i: int) -> dict[str, np.ndarray]:
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_model_params(a: float = 0.0, b: float = 0.0) -> dict:
+    return {"a": np.asarray([a], dtype=np.float32), "b": np.asarray([b], dtype=np.float32)}
+
+
+def regression_apply_fn(params: dict, batch_x):
+    return params["a"] * batch_x + params["b"]
+
+
+def regression_loss_fn(model, batch):
+    pred = model(batch["x"])
+    return ((pred - batch["y"]) ** 2).mean()
+
+
+def make_regression_batches(
+    num_batches: int, batch_size: int, a: float = 2.0, b: float = 3.0, seed: int = 0
+) -> list[dict[str, np.ndarray]]:
+    """Pre-batched numpy data usable directly by DataLoaderShard."""
+    ds = RegressionDataset(a=a, b=b, length=num_batches * batch_size, seed=seed)
+    return [
+        {
+            "x": ds.x[i * batch_size : (i + 1) * batch_size],
+            "y": ds.y[i * batch_size : (i + 1) * batch_size],
+        }
+        for i in range(num_batches)
+    ]

@@ -1,0 +1,394 @@
+"""Experiment trackers.
+
+Capability parity: reference `src/accelerate/tracking.py` (1023 LoC): a
+`GeneralTracker` ABC with main-process gating and built-in integrations
+(TensorBoard, WandB, CometML, Aim, MLflow, ClearML, DVCLive), selected by
+`filter_trackers`. All logging calls are host-side and rank-gated — nothing here
+touches the device path. A dependency-free `JSONLTracker` ("jsonl") is always
+available so runs on bare TPU VMs still record metrics.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any, Callable
+
+from .state import PartialState
+from .utils import imports
+from .utils.operations import listify
+
+_AVAILABLE: dict[str, Callable[[], bool]] = {}
+
+
+def on_main_process(function: Callable) -> Callable:
+    """Gate a tracker method to the main process (reference `tracking.py:67`)."""
+
+    @functools.wraps(function)
+    def wrapper(self, *args, **kwargs):
+        if PartialState().is_main_process:
+            return function(self, *args, **kwargs)
+
+    return wrapper
+
+
+class GeneralTracker:
+    """Tracker ABC (reference `tracking.py:91-161`). Subclasses set ``name``,
+    ``requires_logging_directory`` and implement store_init_configuration/log."""
+
+    name: str = "base"
+    requires_logging_directory: bool = False
+    main_process_only: bool = True
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        pass
+
+    @property
+    def tracker(self) -> Any:
+        return None
+
+    def store_init_configuration(self, values: dict) -> None:
+        raise NotImplementedError
+
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Always-available tracker writing one JSON object per log call."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None):
+        self.run_name = run_name
+        logging_dir = logging_dir or "."
+        os.makedirs(logging_dir, exist_ok=True)
+        self.path = os.path.join(logging_dir, f"{run_name}.metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self) -> Any:
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self._fh.write(json.dumps({"_config": values, "_ts": time.time()}) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        entry = dict(listify(values))
+        entry["_step"] = step
+        entry["_ts"] = time.time()
+        self._fh.write(json.dumps(entry) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs: Any):
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir or ".", run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.add_hparams(values, metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        values = listify(values)
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs: Any):
+        import wandb
+
+        self.run_name = run_name
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs: Any):
+        import mlflow
+
+        self.run_name = run_name
+        exp = mlflow.set_experiment(run_name)
+        self.run = mlflow.start_run(experiment_id=exp.experiment_id, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import mlflow
+
+        for k, v in values.items():
+            mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        import mlflow
+
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        import mlflow
+
+        mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs: Any):
+        from comet_ml import Experiment
+
+        self.run_name = run_name
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs: Any):
+        from aim import Run
+
+        self.run_name = run_name
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self) -> Any:
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs: Any):
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        logger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                logger.report_scalar(title=k, series=k, value=v, iteration=step or 0)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs: Any):
+        from dvclive import Live
+
+        self.live = Live(**kwargs)
+
+    @property
+    def tracker(self) -> Any:
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.live.log_metric(k, v)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
+
+
+LOGGER_TYPE_TO_CLASS: dict[str, type[GeneralTracker]] = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+_AVAILABILITY: dict[str, Callable[[], bool]] = {
+    "jsonl": lambda: True,
+    "tensorboard": imports.is_tensorboard_available,
+    "wandb": imports.is_wandb_available,
+    "mlflow": imports.is_mlflow_available,
+    "comet_ml": imports.is_comet_ml_available,
+    "aim": imports.is_aim_available,
+    "clearml": imports.is_clearml_available,
+    "dvclive": imports.is_dvclive_available,
+}
+
+
+def get_available_trackers() -> list[str]:
+    return [name for name, probe in _AVAILABILITY.items() if probe()]
+
+
+def filter_trackers(
+    log_with: str | list | None,
+    logging_dir: str | None,
+    project_name: str,
+    config: dict | None,
+    init_kwargs: dict,
+) -> list[GeneralTracker]:
+    """Instantiate requested (or all available) trackers — reference `tracking.py:971`."""
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    trackers: list[GeneralTracker] = []
+    names: list[str] = []
+    for entry in log_with:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+            continue
+        entry = str(entry).lower()
+        if entry == "all":
+            names.extend(get_available_trackers())
+        else:
+            names.append(entry)
+    for name in dict.fromkeys(names):
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(f"Unknown tracker {name!r}; choose from {sorted(LOGGER_TYPE_TO_CLASS)}")
+        if not _AVAILABILITY[name]():
+            import logging
+
+            logging.getLogger(__name__).warning("Tracker %s requested but not installed; skipping", name)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[name]
+        kwargs = dict(init_kwargs.get(name, {}))
+        if cls.requires_logging_directory:
+            kwargs.setdefault("logging_dir", logging_dir)
+        tracker = cls(project_name, **kwargs)
+        if config:
+            tracker.store_init_configuration(config)
+        trackers.append(tracker)
+    return trackers

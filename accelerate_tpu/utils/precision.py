@@ -1,0 +1,123 @@
+"""Mixed-precision policies and the dynamic loss scaler.
+
+Capability parity: reference AMP integration (`accelerator.py:472-510`, GradScaler
+factory `utils/modeling.py:1876-1907`, fp8 recipes `utils/dataclasses.py:283-404`).
+
+TPU-native re-founding: instead of autocast context managers patched onto
+``model.forward``, precision is a *functional cast policy* applied around the jitted
+step: master params stay fp32, compute runs in bf16 (the MXU's native input dtype),
+outputs upcast to fp32. bf16 needs no loss scaling on TPU; the fp16 dynamic scaler
+exists for API/capability parity and for the rare fp16 workload, implemented as
+explicit state threaded through the step (no hidden mutable scaler object).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floating(tree: Any, dtype) -> Any:
+    def _cast(t):
+        if hasattr(t, "dtype") and jnp.issubdtype(t.dtype, jnp.floating):
+            return t.astype(dtype)
+        return t
+
+    return jax.tree.map(_cast, tree)
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """What dtype each tensor class lives in. ``param_dtype`` is the master copy;
+    ``compute_dtype`` is what the forward/backward runs in; ``output_dtype`` is
+    what user-visible outputs are cast to (reference `convert_outputs_to_fp32`)."""
+
+    mode: str = "no"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_mode(cls, mode: str | None) -> "PrecisionPolicy":
+        mode = (mode or "no").lower()
+        if mode in ("no", "fp32", "none"):
+            return cls(mode="no")
+        if mode == "bf16":
+            return cls(mode="bf16", compute_dtype=jnp.bfloat16)
+        if mode == "fp16":
+            return cls(mode="fp16", compute_dtype=jnp.float16)
+        if mode == "fp8":
+            # fp8 matmul inputs ride XLA's native fp8 support; master/compute
+            # bookkeeping stays bf16 and per-tensor scaling is handled in ops/fp8.py
+            return cls(mode="fp8", compute_dtype=jnp.bfloat16)
+        raise ValueError(f"Unknown mixed_precision mode {mode!r}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "no"
+
+    @property
+    def requires_loss_scaling(self) -> bool:
+        return self.mode == "fp16"
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        if not self.enabled:
+            return tree
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.output_dtype)
+
+
+class GradScalerState(NamedTuple):
+    """Dynamic loss-scale state (functional analogue of torch GradScaler —
+    reference `get_grad_scaler`, `utils/modeling.py:1876`)."""
+
+    scale: jax.Array
+    growth_tracker: jax.Array  # consecutive finite steps
+
+
+@dataclass
+class DynamicGradScaler:
+    """Doubles the scale every ``growth_interval`` finite steps, halves on overflow,
+    and reports whether the step must be skipped — identical policy to torch's
+    GradScaler, but as explicit state so it lives inside the jitted step."""
+
+    init_scale: float = 2.0**15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+
+    def init(self) -> GradScalerState:
+        return GradScalerState(
+            scale=jnp.asarray(self.init_scale, dtype=jnp.float32),
+            growth_tracker=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: GradScalerState) -> jax.Array:
+        return loss * state.scale
+
+    def unscale_and_update(self, grads: Any, state: GradScalerState):
+        """Unscale grads; detect non-finite values; return
+        (unscaled_grads, new_state, is_finite)."""
+        inv = 1.0 / state.scale
+        grads = jax.tree.map(lambda g: (g * inv).astype(g.dtype), grads)
+        leaves = jax.tree.leaves(grads)
+        finite = jnp.asarray(True)
+        for leaf in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+        new_tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+        grow = new_tracker >= self.growth_interval
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, state.scale * self.growth_factor, state.scale),
+            state.scale * self.backoff_factor,
+        )
+        new_tracker = jnp.where(grow, 0, new_tracker)
+        return grads, GradScalerState(scale=new_scale, growth_tracker=new_tracker), finite

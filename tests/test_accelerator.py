@@ -1,0 +1,267 @@
+"""Accelerator facade tests, including the reference's signature *training parity*
+property (`test_utils/scripts/test_script.py:449-622`): the same model trained
+single-device and 8-device-SPMD must land on identical weights."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.test_utils.training import (
+    make_regression_batches,
+    regression_apply_fn,
+    regression_loss_fn,
+    regression_model_params,
+)
+
+
+def _fresh_accelerator(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _train(accelerator, batches, lr=0.1, max_grad_norm=None, use_fused=False, epochs=1):
+    model, optimizer, dl = accelerator.prepare(
+        (regression_apply_fn, regression_model_params()),
+        optax.sgd(lr),
+        DataLoaderShard(batches) if isinstance(batches, list) else batches,
+    )
+    if use_fused:
+        step = accelerator.make_train_step(regression_loss_fn, max_grad_norm=max_grad_norm)
+        for _ in range(epochs):
+            for batch in dl:
+                step(batch)
+    else:
+        for _ in range(epochs):
+            for batch in dl:
+                with accelerator.accumulate(model):
+                    accelerator.backward(regression_loss_fn, batch)
+                    if max_grad_norm is not None:
+                        accelerator.clip_grad_norm_(max_norm=max_grad_norm)
+                    optimizer.step()
+                    optimizer.zero_grad()
+    return jax.tree.map(np.asarray, accelerator.get_state_dict(model))
+
+
+def _train_reference(batches, lr=0.1, grad_accum=1, max_grad_norm=None, epochs=1):
+    """Plain-JAX single-device baseline, written independently of the framework."""
+    params = {k: jnp.asarray(v) for k, v in regression_model_params().items()}
+
+    def loss_fn(p, batch):
+        pred = p["a"] * batch["x"] + p["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    acc = None
+    count = 0
+    for _ in range(epochs):
+        for batch in batches:
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            g = jax.grad(loss_fn)(params, batch)
+            g = jax.tree.map(lambda x: x / grad_accum, g)
+            acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+            count += 1
+            if count % grad_accum == 0:
+                if max_grad_norm is not None:
+                    norm = optax.global_norm(acc)
+                    factor = jnp.minimum(1.0, max_grad_norm / (norm + 1e-6))
+                    acc = jax.tree.map(lambda x: x * factor, acc)
+                params = jax.tree.map(lambda p, g: p - lr * g, params, acc)
+                acc = None
+    return jax.tree.map(np.asarray, params)
+
+
+class TestTrainingParity:
+    def test_dp_parity_imperative(self):
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches)
+        acc = _fresh_accelerator()
+        got = _train(acc, batches)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+        np.testing.assert_allclose(got["b"], expected["b"], rtol=1e-5)
+
+    def test_dp_parity_fused(self):
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches)
+        acc = _fresh_accelerator()
+        got = _train(acc, batches, use_fused=True)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+
+    def test_grad_accumulation_parity(self):
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches, grad_accum=4)
+        acc = _fresh_accelerator(gradient_accumulation_steps=4)
+        got = _train(acc, batches)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+        np.testing.assert_allclose(got["b"], expected["b"], rtol=1e-5)
+
+    def test_grad_accumulation_fused_parity(self):
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches, grad_accum=4)
+        acc = _fresh_accelerator(gradient_accumulation_steps=4)
+        got = _train(acc, batches, use_fused=True)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+
+    def test_clip_grad_norm_parity(self):
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches, max_grad_norm=0.5)
+        acc = _fresh_accelerator()
+        got = _train(acc, batches, max_grad_norm=0.5)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+
+    def test_fsdp_parity(self):
+        # params too small to shard on fsdp axis -> falls back to replication, but
+        # the config path (sharding inference, placement) is exercised end-to-end
+        batches = make_regression_batches(8, 16)
+        expected = _train_reference(batches)
+        acc = _fresh_accelerator(parallelism_config=ParallelismConfig(data_parallel_size=2, fsdp_size=4))
+        got = _train(acc, batches)
+        np.testing.assert_allclose(got["a"], expected["a"], rtol=1e-5)
+
+    def test_accumulation_flushes_at_end_of_dataloader(self):
+        # 6 batches with accum=4: sync at step 4 and at dataloader end (step 6)
+        batches = make_regression_batches(6, 16)
+        expected = _train_reference(batches[:4], grad_accum=4)
+        acc = _fresh_accelerator(gradient_accumulation_steps=4)
+        model, optimizer, dl = acc.prepare(
+            (regression_apply_fn, regression_model_params()), optax.sgd(0.1), DataLoaderShard(batches)
+        )
+        updates = 0
+        for batch in dl:
+            with acc.accumulate(model):
+                acc.backward(regression_loss_fn, batch)
+                optimizer.step()
+                if acc.sync_gradients:
+                    updates += 1
+                optimizer.zero_grad()
+        assert updates == 2  # one full window + end-of-dataloader flush
+
+
+class TestAcceleratorBasics:
+    def test_prepare_order_preserved(self):
+        acc = _fresh_accelerator()
+        batches = make_regression_batches(2, 16)
+        dl, model, opt = acc.prepare(
+            DataLoaderShard(batches), (regression_apply_fn, regression_model_params()), optax.adam(1e-3)
+        )
+        assert isinstance(dl, DataLoaderShard)
+        assert hasattr(model, "params")
+        assert hasattr(opt, "step")
+
+    def test_prepared_model_forward_bf16(self):
+        acc = _fresh_accelerator(mixed_precision="bf16")
+        model = acc.prepare_model((regression_apply_fn, regression_model_params(2.0, 1.0)))
+        out = model(jnp.ones((8,)))
+        assert out.dtype == jnp.float32  # outputs upcast
+        np.testing.assert_allclose(np.asarray(out), np.full((8,), 3.0), rtol=1e-2)
+
+    def test_optimizer_noop_while_accumulating(self):
+        acc = _fresh_accelerator(gradient_accumulation_steps=2)
+        batches = make_regression_batches(2, 16)
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        before = np.asarray(model.params["a"])
+        with acc.accumulate(model):  # step 1 of 2 -> no sync
+            acc.backward(regression_loss_fn, {k: jnp.asarray(v) for k, v in batches[0].items()})
+            opt.step()
+            opt.zero_grad()
+        assert not acc.sync_gradients
+        np.testing.assert_array_equal(np.asarray(model.params["a"]), before)
+        assert opt.gradients is not None  # zero_grad was a no-op too
+
+    def test_gather_for_metrics_drops_remainder(self):
+        acc = _fresh_accelerator()
+        gs = GradientState()
+        dl = DataLoaderShard([np.arange(16.0)], total_batch_size=16, total_dataset_length=12)
+        outs = []
+        for batch in dl:
+            outs.append(acc.gather_for_metrics(batch))
+        assert outs[0].shape == (12,)
+
+    def test_trigger(self):
+        acc = _fresh_accelerator()
+        assert not acc.check_trigger()
+        acc.set_trigger()
+        assert acc.check_trigger()
+        assert not acc.check_trigger()  # reset after firing
+
+    def test_save_load_state_roundtrip(self, tmp_path):
+        batches = make_regression_batches(4, 16)
+        acc = _fresh_accelerator()
+        model, opt, dl = acc.prepare(
+            (regression_apply_fn, regression_model_params()), optax.adam(0.1), DataLoaderShard(batches)
+        )
+        for batch in dl:
+            with acc.accumulate(model):
+                acc.backward(regression_loss_fn, batch)
+                opt.step()
+                opt.zero_grad()
+        trained_a = np.asarray(model.params["a"]).copy()
+        ckpt = acc.save_state(str(tmp_path / "ckpt"))
+        # perturb, then restore
+        model.params = jax.tree.map(lambda p: p * 0, model.params)
+        acc.load_state(ckpt)
+        np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
+        assert opt.num_updates == 4
+
+    def test_save_model_consolidated(self, tmp_path):
+        from accelerate_tpu.checkpointing import load_model_weights
+
+        acc = _fresh_accelerator()
+        model = acc.prepare_model((regression_apply_fn, regression_model_params(5.0, 7.0)))
+        acc.save_model(model, str(tmp_path / "export"))
+        restored = load_model_weights(str(tmp_path / "export"))
+        np.testing.assert_allclose(restored["a"], [5.0])
+
+    def test_register_for_checkpointing_custom_object(self, tmp_path):
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def state_dict(self):
+                return {"n": self.n}
+
+            def load_state_dict(self, s):
+                self.n = s["n"]
+
+        acc = _fresh_accelerator()
+        c = Counter()
+        c.n = 17
+        acc.register_for_checkpointing(c)
+        ckpt = acc.save_state(str(tmp_path / "ckpt"))
+        c.n = 0
+        acc.load_state(ckpt)
+        assert c.n == 17
+
+    def test_fp16_scaler_skips_on_overflow(self):
+        acc = _fresh_accelerator(mixed_precision="fp16")
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        before = np.asarray(model.params["a"]).copy()
+        # inject an inf gradient manually
+        opt.accumulate_grads({"a": jnp.asarray([jnp.inf]), "b": jnp.asarray([0.0])})
+        opt.step()
+        assert opt.step_was_skipped
+        np.testing.assert_array_equal(np.asarray(model.params["a"]), before)
+
+    def test_scheduler_steps_only_on_sync(self):
+        from accelerate_tpu.scheduler import OptaxSchedule
+
+        acc = _fresh_accelerator(gradient_accumulation_steps=2)
+        batches = make_regression_batches(4, 16)
+        model, opt, sched = acc.prepare(
+            (regression_apply_fn, regression_model_params()),
+            optax.sgd(0.1),
+            OptaxSchedule(optax.linear_schedule(0.1, 0.0, 10)),
+        )
+        for batch in batches:
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            with acc.accumulate(model):
+                acc.backward(regression_loss_fn, batch)
+                opt.step()
+                sched.step()
+                opt.zero_grad()
+        assert sched.scheduler.count == 2  # 4 batches / accum 2
