@@ -1,0 +1,232 @@
+"""GPT-2 family, TPU-first.
+
+This is the flagship training model (BASELINE.md north-star: GPT-2-medium
+pretraining tokens/sec/chip). Design choices for the MXU/XLA:
+
+  - flax.linen with explicit ``dtype`` (compute, bf16 by default on TPU) and
+    ``param_dtype`` (fp32 masters) — matmuls run bf16 on the MXU, layernorm/
+    softmax statistics in fp32.
+  - attention dispatches to the Pallas flash kernel for long sequences (or XLA
+    fused attention otherwise) via `ops.attention.attention`.
+  - optional ``remat`` applies jax.checkpoint per block (HBM <-> FLOPs trade).
+  - optional ``scan_layers`` stacks the blocks with `nn.scan`: one compiled block
+    body instead of n_layer copies — near-constant compile time with depth, and
+    the layer axis becomes a leading param dim (which also gives pipeline
+    parallelism a natural stage axis).
+  - weights are plain kernels ([in, out]) so Megatron-style TP is pure sharding:
+    `gpt2_sharding_rules()` returns the column/row PartitionSpecs.
+
+Interchange: `params_from_hf_gpt2` maps HuggingFace transformers GPT-2 weights
+into this layout (reference capability: big-model checkpoint ingestion).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    scan_layers: bool = False
+    attention_impl: str = "auto"  # 'xla' | 'flash' | 'auto'
+
+    @classmethod
+    def small(cls, **kw) -> "GPT2Config":
+        return cls(**{**dict(n_embd=768, n_layer=12, n_head=12), **kw})
+
+    @classmethod
+    def medium(cls, **kw) -> "GPT2Config":
+        return cls(**{**dict(n_embd=1024, n_layer=24, n_head=16), **kw})
+
+    @classmethod
+    def large(cls, **kw) -> "GPT2Config":
+        return cls(**{**dict(n_embd=1280, n_layer=36, n_head=20), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "GPT2Config":
+        """Test-sized config."""
+        return cls(**{**dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=2), **kw})
+
+
+class SelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        head_dim = e // cfg.n_head
+        qkv = nn.Dense(3 * e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.n_head, head_dim)
+        k = k.reshape(b, s, cfg.n_head, head_dim)
+        v = v.reshape(b, s, cfg.n_head, head_dim)
+        out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
+        out = out.reshape(b, s, e)
+        out = nn.Dense(e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="proj")(out)
+        if cfg.dropout > 0.0 and not deterministic:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=False)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        hidden = cfg.mlp_ratio * cfg.n_embd
+        x = nn.Dense(hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="up")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.Dense(cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="down")(x)
+        if cfg.dropout > 0.0 and not deterministic:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+        return x
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        # pre-norm transformer; LN statistics in fp32
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_1")(x)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_2")(x)
+        x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype), deterministic)
+        return x
+
+
+class GPT2LMHead(nn.Module):
+    """Decoder-only LM. Returns logits [batch, seq, vocab] in fp32."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        b, s = input_ids.shape
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd), cfg.param_dtype
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), cfg.param_dtype
+        )
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :s]
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, deterministic), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, name="blocks"), x, None)
+        else:
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"block_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
+        # tied LM head: logits through the embedding matrix, fp32 accumulation
+        logits = jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits
+
+    def init_params(self, rng: jax.Array, batch: int = 2, seq: int | None = None) -> Any:
+        seq = seq or min(self.config.n_positions, 128)
+        dummy = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, dummy)["params"]
+
+
+def gpt2_sharding_rules() -> ShardingRules:
+    """Megatron-style TP as pure sharding annotations (SURVEY.md §2.4 TP row):
+    qkv/up are column-parallel (shard output dim), proj/down row-parallel (shard
+    input dim), embeddings vocab-sharded. XLA inserts the two all-reduces per
+    block that Megatron hand-codes."""
+    return ShardingRules(
+        rules=[
+            (r".*attn/qkv/kernel", P(None, "tensor")),
+            (r".*attn/proj/kernel", P("tensor", None)),
+            (r".*mlp/up/kernel", P(None, "tensor")),
+            (r".*mlp/down/kernel", P("tensor", None)),
+            (r".*wte", P("tensor", None)),
+            (r".*wpe", P(None, None)),
+            (r".*(qkv|up)/bias", P("tensor")),
+        ]
+    )
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array, ignore_index: int = -100) -> jax.Array:
+    """Token-level CE with masking, fp32 accumulation."""
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
+
+
+def lm_loss_fn(model, batch) -> jax.Array:
+    """Next-token LM loss usable directly with Accelerator.backward/make_train_step."""
+    logits = model(batch["input_ids"])
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    return cross_entropy_loss(logits, labels)
+
+
+def params_from_hf_gpt2(hf_state_dict: dict, config: GPT2Config) -> dict:
+    """Map HuggingFace transformers GPT-2 torch weights into this layout.
+
+    HF GPT-2 uses Conv1D (weights already [in, out]); layer names are remapped.
+    (Capability parity with the reference's checkpoint ingestion,
+    `utils/modeling.py:1611` load_checkpoint_in_model.)
+    """
+
+    def _np(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    p: dict[str, Any] = {
+        "wte": _np(hf_state_dict["wte.weight"]),
+        "wpe": _np(hf_state_dict["wpe.weight"]),
+        "ln_f": {"scale": _np(hf_state_dict["ln_f.weight"]), "bias": _np(hf_state_dict["ln_f.bias"])},
+    }
+    for i in range(config.n_layer):
+        hf = f"h.{i}."
+        p[f"block_{i}"] = {
+            "ln_1": {"scale": _np(hf_state_dict[hf + "ln_1.weight"]), "bias": _np(hf_state_dict[hf + "ln_1.bias"])},
+            "ln_2": {"scale": _np(hf_state_dict[hf + "ln_2.weight"]), "bias": _np(hf_state_dict[hf + "ln_2.bias"])},
+            "attn": {
+                "qkv": {"kernel": _np(hf_state_dict[hf + "attn.c_attn.weight"]), "bias": _np(hf_state_dict[hf + "attn.c_attn.bias"])},
+                "proj": {"kernel": _np(hf_state_dict[hf + "attn.c_proj.weight"]), "bias": _np(hf_state_dict[hf + "attn.c_proj.bias"])},
+            },
+            "mlp": {
+                "up": {"kernel": _np(hf_state_dict[hf + "mlp.c_fc.weight"]), "bias": _np(hf_state_dict[hf + "mlp.c_fc.bias"])},
+                "down": {"kernel": _np(hf_state_dict[hf + "mlp.c_proj.weight"]), "bias": _np(hf_state_dict[hf + "mlp.c_proj.bias"])},
+            },
+        }
+    return p

@@ -1,0 +1,93 @@
+"""Attention ops.
+
+The compute core the reference delegates to external engines (Megatron fused
+kernels, TransformerEngine) is implemented here natively for TPU:
+
+  - ``dot_product_attention``: XLA path — einsum QK^T -> masked softmax -> PV.
+    XLA fuses the elementwise chain into the matmuls; with bf16 inputs both
+    matmuls tile straight onto the MXU. Good to ~4k sequence.
+  - a Pallas flash/splash kernel lives in `ops/flash_attention.py` (blockwise,
+    O(seq) memory) and is selected automatically for long sequences on TPU.
+  - ring attention for sequence-parallel meshes lives in
+    `parallel/ring_attention.py` (ppermute KV rotation over ICI).
+
+All functions take [batch, seq, heads, head_dim] ("BSHD") layouts — the layout
+that keeps the head dim contiguous in lane registers on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32, offset: int = 0) -> jax.Array:
+    """Additive causal mask [q_len, kv_len]; query i attends to keys <= i+offset."""
+    q_idx = jnp.arange(q_len)[:, None]
+    k_idx = jnp.arange(kv_len)[None, :]
+    allowed = k_idx <= (q_idx + offset)
+    return jnp.where(allowed, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H, D]
+    v: jax.Array,  # [B, Sk, H, D]
+    bias: jax.Array | None = None,
+    mask: jax.Array | None = None,  # boolean [B, 1|H, Sq, Sk] or [Sq, Sk], True=keep
+    causal: bool = False,
+    scale: float | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    dtype=None,
+) -> jax.Array:
+    """Plain XLA attention. Softmax accumulates in fp32 regardless of input dtype
+    (bf16 logits lose too much range), output returns to the input dtype."""
+    orig_dtype = q.dtype
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        logits = logits + causal_mask(q.shape[1], k.shape[1])[None, None, :, :]
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None, :, :]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+    weights = weights.astype(orig_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    implementation: str = "auto",
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Dispatching entry point: 'xla' | 'flash' | 'auto'.
+
+    'auto' picks the Pallas flash kernel on TPU for sequences where the
+    O(S^2) logits buffer dominates HBM traffic, else the fused XLA path.
+    """
+    if implementation == "auto":
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        implementation = "flash" if (on_tpu and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]) else "xla"
+    if implementation == "flash":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask)

@@ -1,0 +1,90 @@
+"""Benchmark: GPT-2 training throughput on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no training-throughput numbers (BASELINE.md), so
+vs_baseline is reported against the north-star MFU target of 40%:
+vs_baseline = achieved_MFU / 0.40 (>1.0 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    # GPT-2 small on one v5e chip; CPU fallback uses a tiny config so CI completes
+    if on_tpu:
+        cfg = GPT2Config.small(dtype=jnp.bfloat16, attention_impl="xla", remat=False)
+        batch, seq, iters = 8, 1024, 30
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        batch, seq, iters = 8, 64, 5
+
+    acc = Accelerator(mixed_precision="bf16" if on_tpu else "no")
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
+    model, opt = acc.prepare((module, params), optax.adamw(1e-4))
+    step = acc.make_train_step(lm_loss_fn)
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)), dtype=jnp.int32
+    )
+    batch_data = {"input_ids": ids}
+
+    # warmup/compile; float() forces a device->host transfer, which is the only
+    # reliable full sync on relayed TPU backends (block_until_ready can return
+    # before remote execution completes)
+    float(step(batch_data))
+    float(step(batch_data))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(batch_data)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    n_chips = len(jax.devices())
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+
+    # MFU: ~6*N FLOPs/token (fwd+bwd) + attention term 12*s*e per token per layer
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    flops_per_token = 6 * n_params + cfg.n_layer * 12 * seq * cfg.n_embd
+    achieved_flops = tokens_per_sec_chip * flops_per_token
+    peak_flops = 394e12 if on_tpu else 1e12  # v5e bf16 peak per chip
+    mfu = achieved_flops / peak_flops
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "detail": {
+                    "mfu": round(mfu, 4),
+                    "model": "gpt2-small" if on_tpu else "gpt2-tiny(cpu)",
+                    "batch": batch,
+                    "seq": seq,
+                    "platform": jax.devices()[0].platform,
+                    "loss": round(final_loss, 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Flash-attention kernel vs XLA reference: forward and gradients, causal and not."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.ops.attention import dot_product_attention
+from accelerate_tpu.ops.flash_attention import flash_attention
+
+
+def _rand(shape, key):
+    return jax.random.normal(jax.random.key(key), shape, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 128, 4, 64), (1, 256, 2, 32)])
+def test_flash_matches_xla_forward(causal, shape):
+    b, s, h, d = shape
+    q, k, v = _rand(shape, 0), _rand(shape, 1), _rand(shape, 2)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_kv=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match(causal):
+    shape = (1, 128, 2, 32)
+    q, k, v = _rand(shape, 3), _rand(shape, 4), _rand(shape, 5)
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=causal) ** 2).sum()
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, block_q=64, block_kv=64) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
+
+
+def test_flash_bf16():
+    shape = (1, 128, 2, 64)
+    q = _rand(shape, 6).astype(jnp.bfloat16)
+    k = _rand(shape, 7).astype(jnp.bfloat16)
+    v = _rand(shape, 8).astype(jnp.bfloat16)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref, dtype=np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_flash_rejects_indivisible():
+    shape = (1, 100, 2, 32)
+    q = _rand(shape, 9)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=64, block_kv=64)

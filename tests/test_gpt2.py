@@ -1,0 +1,125 @@
+"""GPT-2 model tests: shapes, loss decrease under Accelerator training, TP/FSDP
+sharded training parity with the single-logical-device result."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHead,
+    cross_entropy_loss,
+    gpt2_sharding_rules,
+    lm_loss_fn,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _fresh(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _toy_batches(num, batch=8, seq=32, vocab=256, seed=0):
+    """Learnable data: each row repeats one token, so next-token prediction is easy."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num):
+        tokens = rng.integers(0, vocab, size=(batch, 1)).astype(np.int32)
+        out.append({"input_ids": np.repeat(tokens, seq, axis=1)})
+    return out
+
+
+def test_forward_shapes_fp32_logits():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2LMHead(cfg)
+    params = model.init_params(jax.random.key(0))
+    logits = model.apply({"params": params}, jnp.zeros((2, 16), dtype=jnp.int32))
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_cross_entropy_masking():
+    logits = jnp.zeros((1, 4, 8))
+    labels = jnp.array([[1, 2, -100, -100]])
+    loss = cross_entropy_loss(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+def test_scan_layers_matches_loop():
+    ids = jnp.arange(32, dtype=jnp.int32).reshape(1, 32) % 256
+    cfg_loop = GPT2Config.tiny(dtype=jnp.float32)
+    model_loop = GPT2LMHead(cfg_loop)
+    params = model_loop.init_params(jax.random.key(1))
+    out_loop = model_loop.apply({"params": params}, ids)
+    # scan variant has its own param layout; just check it runs + same shapes
+    cfg_scan = GPT2Config.tiny(dtype=jnp.float32, scan_layers=True)
+    model_scan = GPT2LMHead(cfg_scan)
+    params_scan = model_scan.init_params(jax.random.key(1))
+    out_scan = model_scan.apply({"params": params_scan}, ids)
+    assert out_scan.shape == out_loop.shape
+    assert params_scan["blocks"]["attn"]["qkv"]["kernel"].shape[0] == cfg_scan.n_layer
+
+
+def _train_gpt2(accelerator, batches, cfg, lr=1e-2, seed=0):
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(seed))
+    model, opt, dl = accelerator.prepare(
+        (module, params), optax.adamw(lr), DataLoaderShard(batches)
+    )
+    step = accelerator.make_train_step(lm_loss_fn)
+    losses = [float(step(b)) for b in dl]
+    return losses, accelerator.get_state_dict(model)
+
+
+def test_training_reduces_loss_dp():
+    acc = _fresh()
+    losses, _ = _train_gpt2(acc, _toy_batches(8), GPT2Config.tiny(dtype=jnp.float32))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize(
+    "pconf",
+    [
+        ParallelismConfig(data_parallel_size=2, tensor_size=4),
+        ParallelismConfig(data_parallel_size=2, fsdp_size=4),
+        ParallelismConfig(data_parallel_size=2, fsdp_size=2, tensor_size=2),
+    ],
+    ids=["tp4", "fsdp4", "dp2xfsdp2xtp2"],
+)
+def test_sharded_training_parity(pconf):
+    """TP/FSDP/hybrid sharded training must produce the same weights as pure DP."""
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    batches = _toy_batches(4)
+    acc0 = _fresh()
+    losses0, params0 = _train_gpt2(acc0, batches, cfg)
+    acc1 = _fresh(parallelism_config=pconf, sharding_rules=gpt2_sharding_rules())
+    losses1, params1 = _train_gpt2(acc1, batches, cfg)
+    np.testing.assert_allclose(losses0, losses1, rtol=5e-4, atol=5e-5)
+    # adam's sqrt(nu) normalization amplifies cross-sharding reduction-order noise
+    # on near-zero params, so compare with an absolute floor
+    for (ka), (kb) in zip(
+        jax.tree_util.tree_leaves_with_path(params0), jax.tree_util.tree_leaves_with_path(params1)
+    ):
+        np.testing.assert_allclose(np.asarray(ka[1]), np.asarray(kb[1]), rtol=5e-3, atol=3e-3)
+
+
+def test_tp_params_actually_sharded():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    acc = _fresh(
+        parallelism_config=ParallelismConfig(data_parallel_size=2, tensor_size=4),
+        sharding_rules=gpt2_sharding_rules(),
+    )
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    model = acc.prepare_model((module, params))
+    qkv = model.params["block_0"]["attn"]["qkv"]["kernel"]
+    # column-parallel: output dim split over tensor axis (4 shards x 2 dp replicas)
+    shard_shape = qkv.sharding.shard_shape(qkv.shape)
+    assert shard_shape[1] == qkv.shape[1] // 4
