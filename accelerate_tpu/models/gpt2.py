@@ -82,7 +82,14 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
         v = v.reshape(b, s, cfg.n_head, head_dim)
-        out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
+        if cfg.attention_impl == "ring":
+            # sequence-parallel exact attention over the mesh's ring axis
+            from ..parallel.ring_attention import ring_attention_sharded
+            from ..state import AcceleratorState
+
+            out = ring_attention_sharded(q, k, v, AcceleratorState().mesh, causal=True)
+        else:
+            out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
         out = out.reshape(b, s, e)
         out = nn.Dense(e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="proj")(out)
         if cfg.dropout > 0.0 and not deterministic:

@@ -1,0 +1,129 @@
+"""Pipeline parallelism: GPipe microbatch schedule as SPMD collective-permute.
+
+Capability parity: the reference's PP comes from external engines — Megatron's
+pipelined train_step for training and PiPPy's `ScheduleGPipe` for inference
+(SURVEY.md §2.4 PP row). TPU-native re-founding (MPMD-over-SPMD): every device
+runs the *same* jitted program over a ``stage`` mesh axis; stage-local parameters
+are sharded on the leading (stage) dim, activations hop stage r -> r+1 with
+`lax.ppermute` each tick, and the classic GPipe bubble (M + S - 1 ticks for M
+microbatches over S stages) emerges from the schedule, not from per-rank code.
+
+The tick loop is a `lax.scan` (reverse-differentiable); `jax.checkpoint` around
+the stage body keeps backward memory at one activation per tick instead of the
+whole per-tick residual set. Loss can be folded in on the last stage so only a
+scalar psum leaves the pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(
+    stage_fn: Callable,
+    params: Any,  # this stage's param slice (leading stage dim consumed)
+    x_mb: jax.Array,  # [M, mb, ...] microbatched input, replicated across stages
+    out_fn: Callable | None,
+    out_fn_args: Any,
+    axis_name: str,
+):
+    S = jax.lax.axis_size(axis_name)
+    r = jax.lax.axis_index(axis_name)
+    # shard_map leaves a local leading stage dim of size 1 on the param slice
+    params = jax.tree.map(lambda p: p[0], params)
+    M = x_mb.shape[0]
+    T = M + S - 1
+    ckpt_stage = jax.checkpoint(lambda p, x: stage_fn(p, x))
+
+    def tick(carry, t):
+        state = carry  # activation entering this stage this tick
+        # stage 0 injects microbatch t (clamped; masked-out ticks produce garbage
+        # that never reaches an output row)
+        inj = x_mb[jnp.clip(t, 0, M - 1)]
+        state = jnp.where(r == 0, inj.astype(state.dtype), state)
+        y = ckpt_stage(params, state)
+        # pass activations along the ring; the wraparound (last -> 0) is ignored
+        # because stage 0 overwrites with the next injection
+        y_next = jax.lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        return y_next, y
+
+    state0 = jnp.zeros_like(stage_eval_shape(stage_fn, params, x_mb[0]))
+    _, ys = jax.lax.scan(tick, state0, jnp.arange(T))  # ys: [T, mb, ...] per stage
+
+    # microbatch m exits the last stage at tick m + S - 1
+    outs = ys[S - 1 :]  # [M, mb, ...] valid only on the last stage
+    if out_fn is None:
+        # replicate the last stage's outputs everywhere (scalar-free generic path)
+        mask = (r == S - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * mask, axis_name)
+    losses = jax.vmap(lambda y, a: out_fn(y, a))(outs, out_fn_args)  # [M]
+    mask = (r == S - 1).astype(losses.dtype)
+    return jax.lax.psum((losses * mask).mean(), axis_name)
+
+
+def stage_eval_shape(stage_fn: Callable, params: Any, x: jax.Array) -> jax.Array:
+    """Zero-cost shape probe of a stage's output (stages must be shape-preserving
+    pipelines over the same activation shape, the GPipe contract)."""
+    shape = jax.eval_shape(stage_fn, params, x)
+    return jnp.zeros(shape.shape, shape.dtype)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params: Any,  # pytree; every leaf has leading dim = num stages
+    x: jax.Array,  # global input [batch, ...]
+    mesh: Mesh,
+    num_microbatches: int,
+    out_fn: Callable | None = None,
+    out_fn_args: Any = None,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Run a stage-sharded model as a GPipe pipeline under jit.
+
+    ``stage_fn(stage_params, x_mb) -> y_mb`` is one stage's forward on one
+    microbatch. With ``out_fn(y_mb, args_mb) -> scalar`` given, returns the mean
+    loss (computed on the last stage, psum-broadcast); otherwise returns the
+    stacked outputs [batch, ...].
+    """
+    S = mesh.shape[axis_name]
+    if S == 1:
+        raise ValueError("pipeline_apply requires a non-trivial stage axis")
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} must divide into {num_microbatches} microbatches")
+    mb = b // num_microbatches
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+    args_mb = None
+    if out_fn_args is not None:
+        args_mb = jax.tree.map(
+            lambda a: a.reshape(num_microbatches, mb, *a.shape[1:]), out_fn_args
+        )
+
+    from jax import shard_map
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = functools.partial(_pipeline_local, stage_fn, axis_name=axis_name)
+
+    def wrapped(params, x_mb, args_mb):
+        return fn(params, x_mb, out_fn, args_mb)
+
+    result = shard_map(
+        wrapped,
+        mesh=mesh,
+        in_specs=(param_specs, P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_mb, args_mb)
+    if out_fn is None:
+        return result.reshape(b, *result.shape[2:])
+    return result
+
+
+def stack_stage_params(per_stage_params: list[Any]) -> Any:
+    """Stack a list of per-stage param pytrees along a new leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

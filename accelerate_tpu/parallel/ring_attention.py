@@ -1,0 +1,128 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+The reference has **no** context parallelism at all (SURVEY.md §5 long-context:
+"no ring attention, Ulysses, or blockwise attention anywhere in the repo") — its
+only lever is Megatron's activation-sharding flag. This module is the green-field
+TPU design: the sequence dimension is sharded over the ``sequence`` mesh axis and
+KV chunks rotate around the ring with `lax.ppermute` while each device accumulates
+its queries' attention with running log-sum-exp merging (blockwise-exact, no
+approximation). On TPU the ppermute rides ICI neighbor links, overlapping with the
+local attention compute — sequence length scales linearly with ring size at
+constant per-device memory.
+
+Each ring step is wrapped in `jax.checkpoint` so backward recomputes block logits
+instead of storing O(S^2/n) residuals per step.
+
+Use `ring_attention` inside `shard_map`, or `ring_attention_sharded` as a drop-in
+for [batch, seq, heads, head_dim] global arrays under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_offset, kv_offset, causal, scale):
+    """Attention of a local Q chunk against one KV chunk, returning the
+    *unnormalized* accumulator and per-row (max, denom) statistics in fp32.
+
+    q: [B, Sq, H, D]; k/v: [B, Skv, H, D]. Offsets are global positions of the
+    chunks, used for exact causal masking at shard boundaries.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kv_pos = kv_offset + jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where((kv_pos <= q_pos)[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [B,H,Sq,1]
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m[..., 0], l[..., 0]  # acc [B,Sq,H,D]; m,l [B,H,Sq]
+
+
+def ring_attention(
+    q: jax.Array,  # local chunk [B, S/n, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sequence",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact ring attention; call inside shard_map with ``axis_name`` bound.
+
+    Device r holds query chunk r. At ring step t it attends the KV chunk that
+    started on device (r + t) mod n, then passes its current KV to device r-1
+    (so chunks travel r -> r-1 -> ...). Running (max, denom, acc) statistics merge
+    each block exactly as flash attention does across kv blocks.
+    """
+    n = jax.lax.axis_size(axis_name)
+    r = jax.lax.axis_index(axis_name)
+    b, s_chunk, h, d = q.shape
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    q_offset = r * s_chunk
+
+    def step(t, carry):
+        k_cur, v_cur, acc, m, l = carry
+        kv_idx = (r + t) % n
+        kv_offset = kv_idx * s_chunk
+
+        blk = functools.partial(_block_attention, causal=causal, scale=scale)
+        acc_b, m_b, l_b = jax.checkpoint(blk)(q, k_cur, v_cur, q_offset, kv_offset)
+
+        # merge running statistics (flash-style)
+        m_new = jnp.maximum(m, m_b)  # [B,H,Sq]
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = l * alpha + l_b * beta
+        # acc layout [B,Sq,H,D]; stats layout [B,H,Sq] -> transpose for broadcast
+        alpha_t = jnp.transpose(alpha, (0, 2, 1))[..., None]
+        beta_t = jnp.transpose(beta, (0, 2, 1))[..., None]
+        acc_new = acc * alpha_t + acc_b * beta_t
+
+        # rotate KV around the ring: send to r-1, receive from r+1
+        perm = [(i, (i - 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((b, s_chunk, h, d), dtype=jnp.float32)
+    m0 = jnp.full((b, h, s_chunk), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, s_chunk), dtype=jnp.float32)
+    _, _, acc, m, l = jax.lax.fori_loop(0, n, step, (k, v, acc0, m0, l0))
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]
+    out = acc / jnp.where(l_t == 0.0, 1.0, l_t)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,  # global [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """shard_map wrapper: batch over data axes, sequence over the ring axis.
+    Falls back to plain attention when the sequence axis is trivial."""
+    if mesh.shape.get("sequence", 1) == 1:
+        from ..ops.attention import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    from jax import shard_map
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    spec = P(batch_axes if batch_axes else None, "sequence", None, None)
+
+    fn = functools.partial(ring_attention, axis_name="sequence", causal=causal, scale=scale)
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

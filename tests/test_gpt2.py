@@ -110,6 +110,17 @@ def test_sharded_training_parity(pconf):
         np.testing.assert_allclose(np.asarray(ka[1]), np.asarray(kb[1]), rtol=5e-3, atol=3e-3)
 
 
+def test_sequence_parallel_training_parity():
+    """Ring-attention sequence parallelism == pure DP training."""
+    batches = _toy_batches(4)
+    acc0 = _fresh()
+    losses0, params0 = _train_gpt2(acc0, batches, GPT2Config.tiny(dtype=jnp.float32))
+    acc1 = _fresh(parallelism_config=ParallelismConfig(data_parallel_size=2, sequence_size=4))
+    cfg_sp = GPT2Config.tiny(dtype=jnp.float32, attention_impl="ring")
+    losses1, params1 = _train_gpt2(acc1, batches, cfg_sp)
+    np.testing.assert_allclose(losses0, losses1, rtol=1e-3, atol=1e-4)
+
+
 def test_tp_params_actually_sharded():
     cfg = GPT2Config.tiny(dtype=jnp.float32)
     acc = _fresh(
