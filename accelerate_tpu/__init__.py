@@ -1,0 +1,46 @@
+"""accelerate-tpu: TPU-native training orchestration with the HF Accelerate
+capability surface, re-founded on JAX/XLA (see SURVEY.md for the mapping).
+
+Public API parity: reference `src/accelerate/__init__.py`.
+"""
+
+__version__ = "0.1.0"
+
+from .accelerator import (
+    Accelerator,
+    BoundModel,
+    GradientAccumulationPlugin,
+    PreparedModel,
+    ProjectConfiguration,
+)
+from .data_loader import (
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from .launchers import debug_launcher, notebook_launcher
+from .logging import get_logger
+from .memory import find_executable_batch_size, release_memory
+from .optimizer import AcceleratedOptimizer
+from .parallel.mesh import ParallelismConfig, build_mesh
+from .parallel.pipeline import pipeline_apply, stack_stage_params
+from .parallel.ring_attention import ring_attention, ring_attention_sharded
+from .parallel.sharding import ShardingRules, infer_param_shardings
+from .scheduler import AcceleratedScheduler, OptaxSchedule
+from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .utils.operations import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    gather,
+    gather_object,
+    pad_across_processes,
+    reduce,
+    send_to_device,
+)
+from .utils.precision import DynamicGradScaler, PrecisionPolicy
+from .utils.random import set_seed, synchronize_rng_states

@@ -18,6 +18,7 @@ users keep their training-loop shape.
 from __future__ import annotations
 
 import contextlib
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
@@ -185,6 +186,17 @@ class Accelerator:
         **kwargs: Any,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if parallelism_config is None:
+            # launcher env contract (commands/launch.py): dp,fsdp,stage,seq,tp
+            env_par = os.environ.get("ACCELERATE_TPU_PARALLELISM")
+            if env_par:
+                dp, fsdp, stage, seq, tp = (int(x) for x in env_par.split(","))
+                parallelism_config = ParallelismConfig(
+                    data_parallel_size=dp, fsdp_size=fsdp, stage_size=stage,
+                    sequence_size=seq, tensor_size=tp,
+                )
+        if gradient_accumulation_steps == 1:
+            gradient_accumulation_steps = int(os.environ.get("ACCELERATE_TPU_GRAD_ACCUM_STEPS", 1))
         self.state = AcceleratorState(
             mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
         )

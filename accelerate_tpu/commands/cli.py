@@ -1,0 +1,26 @@
+"""`accelerate-tpu` CLI root (reference `commands/accelerate_cli.py`):
+subcommands config / env / launch / test / estimate-memory / merge-weights /
+tpu-config."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> None:
+    from . import config, env, estimate, launch, merge, test, tpu
+
+    parser = argparse.ArgumentParser("accelerate-tpu", usage="accelerate-tpu <command> [<args>]")
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    for mod in (config, env, launch, test, estimate, merge, tpu):
+        mod.add_parser(subparsers)
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        sys.exit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""`accelerate-tpu config` — interactive questionnaire + YAML config file.
+
+Capability parity: reference `commands/config/` (cluster questionnaire,
+config_args.py, default.py write_basic_config). The YAML holds the launcher
+defaults; precedence everywhere is CLI flag > ACCELERATE_TPU_* env > config file
+(reference §5 config planes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+import yaml
+
+from ..utils.constants import DEFAULT_CONFIG_DIR_ENV, DEFAULT_CONFIG_NAME
+
+
+def default_config_file() -> Path:
+    base = os.environ.get(DEFAULT_CONFIG_DIR_ENV)
+    if base is None:
+        base = os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu")
+    return Path(base) / DEFAULT_CONFIG_NAME
+
+
+@dataclass
+class LaunchConfig:
+    """Everything the launcher needs to start a run (reference ClusterConfig)."""
+
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    num_processes: int = 1  # hosts
+    process_id: int = 0
+    coordinator_address: str | None = None  # host0:port for jax.distributed
+    mixed_precision: str = "no"
+    data_parallel_size: int = -1
+    fsdp_size: int = 1
+    tensor_size: int = 1
+    sequence_size: int = 1
+    stage_size: int = 1
+    gradient_accumulation_steps: int = 1
+    debug: bool = False
+
+    def to_yaml(self, path: Path | None = None) -> Path:
+        path = path or default_config_file()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(asdict(self), f, sort_keys=False)
+        return path
+
+    @classmethod
+    def from_yaml(cls, path: Path | None = None) -> "LaunchConfig":
+        path = path or default_config_file()
+        if not Path(path).exists():
+            return cls()
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+        known = {k: v for k, v in data.items() if k in cls.__dataclass_fields__}
+        return cls(**known)
+
+
+def write_basic_config(mixed_precision: str = "no", save_location: str | None = None) -> Path:
+    """One-call default config (reference `commands/config/default.py:write_basic_config`)."""
+    cfg = LaunchConfig(mixed_precision=mixed_precision)
+    return cfg.to_yaml(Path(save_location) if save_location else None)
+
+
+def _ask(prompt: str, default: str, choices: list[str] | None = None) -> str:
+    suffix = f" [{'/'.join(choices)}]" if choices else ""
+    raw = input(f"{prompt}{suffix} ({default}): ").strip()
+    value = raw or default
+    if choices and value not in choices:
+        print(f"  invalid choice {value!r}, using {default}")
+        return default
+    return value
+
+
+def config_command(args: argparse.Namespace) -> None:
+    if getattr(args, "default", False):
+        path = write_basic_config(mixed_precision=getattr(args, "mixed_precision", "no"))
+        print(f"Wrote default config to {path}")
+        return
+    print("accelerate-tpu configuration")
+    cfg = LaunchConfig()
+    cfg.compute_environment = _ask(
+        "Compute environment", "LOCAL_MACHINE", ["LOCAL_MACHINE", "TPU_POD"]
+    )
+    if cfg.compute_environment == "TPU_POD":
+        cfg.num_processes = int(_ask("Number of hosts (TPU workers)", "1"))
+        cfg.coordinator_address = _ask("Coordinator address (host0:port)", "") or None
+    cfg.mixed_precision = _ask("Mixed precision", "bf16", ["no", "bf16", "fp16", "fp8"])
+    cfg.gradient_accumulation_steps = int(_ask("Gradient accumulation steps", "1"))
+    cfg.fsdp_size = int(_ask("FSDP (parameter-shard) degree", "1"))
+    cfg.tensor_size = int(_ask("Tensor-parallel degree", "1"))
+    cfg.sequence_size = int(_ask("Sequence-parallel (ring) degree", "1"))
+    cfg.stage_size = int(_ask("Pipeline stages", "1"))
+    path = cfg.to_yaml(Path(args.config_file) if getattr(args, "config_file", None) else None)
+    print(f"Configuration saved to {path}")
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("config", help="create the launch configuration interactively")
+    p.add_argument("--config_file", default=None, help="where to save the YAML")
+    p.add_argument("--default", action="store_true", help="write defaults without prompting")
+    p.add_argument("--mixed_precision", default="no")
+    p.set_defaults(func=config_command)

@@ -1,0 +1,37 @@
+"""`accelerate-tpu env` — environment dump for bug reports (reference `commands/env.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import platform
+
+
+def env_command(args: argparse.Namespace) -> None:
+    import jax
+
+    import accelerate_tpu
+    from .config import default_config_file
+
+    info = {
+        "accelerate_tpu version": getattr(accelerate_tpu, "__version__", "dev"),
+        "platform": platform.platform(),
+        "python": platform.python_version(),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "devices": str(jax.devices()),
+        "process_count": jax.process_count(),
+        "config file": str(default_config_file()),
+    }
+    try:
+        import flax, optax  # noqa
+
+        info["flax"] = flax.__version__
+        info["optax"] = optax.__version__
+    except ImportError:
+        pass
+    print("\n".join(f"- {k}: {v}" for k, v in info.items()))
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("env", help="print environment info")
+    p.set_defaults(func=env_command)

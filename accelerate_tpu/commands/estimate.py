@@ -1,0 +1,78 @@
+"""`accelerate-tpu estimate-memory` — model memory estimation without weights.
+
+Capability parity: reference `commands/estimate.py` (meta-device model sizing via
+`calculate_maximum_sizes`). TPU-native: sizes come from `jax.eval_shape` over the
+model init (zero FLOPs, zero memory) for in-repo models, or from a HuggingFace
+config's parameter arithmetic for Hub names when transformers is installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+
+DTYPE_BYTES = {"float32": 4, "bf16": 2, "bfloat16": 2, "fp16": 2, "float16": 2, "int8": 1, "fp8": 1}
+
+
+def _fmt(nbytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if nbytes < 1024:
+            return f"{nbytes:.2f} {unit}"
+        nbytes /= 1024
+    return f"{nbytes:.2f} PB"
+
+
+def estimate_parameters(model_name: str) -> int:
+    """Parameter count for an in-repo model spec ('gpt2', 'gpt2-medium', ...) or a
+    HF Hub model (config-only download)."""
+    sizes = {"gpt2": "small", "gpt2-small": "small", "gpt2-medium": "medium", "gpt2-large": "large"}
+    if model_name in sizes:
+        import jax
+
+        from ..models.gpt2 import GPT2Config, GPT2LMHead
+
+        cfg = getattr(GPT2Config, sizes[model_name])()
+        module = GPT2LMHead(cfg)
+        shapes = jax.eval_shape(
+            lambda: module.init(jax.random.key(0), jax.numpy.zeros((1, 8), dtype=jax.numpy.int32))
+        )
+        return sum(int(math.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    try:
+        from transformers import AutoConfig
+
+        cfg = AutoConfig.from_pretrained(model_name)
+        from transformers import AutoModel
+
+        import torch
+
+        with torch.device("meta"):
+            model = AutoModel.from_config(cfg)
+        return sum(p.numel() for p in model.parameters())
+    except Exception as e:
+        raise ValueError(
+            f"Unknown model {model_name!r}: not an in-repo spec and transformers "
+            f"meta-load failed ({e})"
+        )
+
+
+def estimate_command(args: argparse.Namespace) -> None:
+    n = estimate_parameters(args.model_name)
+    rows = []
+    for dtype in args.dtypes:
+        b = DTYPE_BYTES[dtype]
+        params = n * b
+        # training ~= params + grads + adam (2x fp32 moments) + master fp32 params
+        train = params + n * b + 2 * n * 4 + (n * 4 if b < 4 else 0)
+        rows.append((dtype, _fmt(params), _fmt(train)))
+    w = max(len(r[1]) for r in rows) + 2
+    print(f"Model: {args.model_name} — {n:,} parameters")
+    print(f"{'dtype':8} {'inference':>{w}} {'training (adam)':>{w+8}}")
+    for dtype, inf, train in rows:
+        print(f"{dtype:8} {inf:>{w}} {train:>{w+8}}")
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("estimate-memory", help="estimate model memory usage")
+    p.add_argument("model_name")
+    p.add_argument("--dtypes", nargs="+", default=["float32", "bf16"], choices=list(DTYPE_BYTES))
+    p.set_defaults(func=estimate_command)

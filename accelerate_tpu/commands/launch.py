@@ -1,0 +1,161 @@
+"""`accelerate-tpu launch` — start training across any topology.
+
+Capability parity: reference `commands/launch.py` (1178 LoC) + `utils/launch.py`.
+The reference must spawn one process per *device* (torchelastic, xmp.spawn, pdsh);
+under JAX SPMD there is exactly **one process per host** and all local chips are
+already visible, so launching collapses to: resolve config -> export the
+launcher<->library env contract -> run the script. Modes:
+
+  - single host ("LOCAL_MACHINE"): exec the script in-process.
+  - TPU pod ("TPU_POD"): each host runs the same command (GKE/gcloud fan-out is
+    `tpu-config`'s job, reference `commands/tpu.py`); env carries
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or everything
+    autodetects from TPU metadata when unset.
+  - `--debug_cpu N`: fork N local processes, each a JAX "host" on the CPU
+    platform with a localhost coordinator — the reference's `debug_launcher`
+    (2-proc gloo CPU) capability, but exercising the *real* multi-process
+    collective path over gRPC.
+
+Env contract (consumed by `state.py` / `Accelerator`): ACCELERATE_TPU_MIXED_PRECISION,
+ACCELERATE_TPU_GRAD_ACCUM_STEPS, ACCELERATE_TPU_PARALLELISM (dp,fsdp,stage,seq,tp),
+ACCELERATE_TPU_DEBUG_MODE.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+from pathlib import Path
+
+from .config import LaunchConfig, default_config_file
+
+
+def launch_env(cfg: LaunchConfig) -> dict[str, str]:
+    env: dict[str, str] = {
+        "ACCELERATE_TPU_MIXED_PRECISION": cfg.mixed_precision,
+        "ACCELERATE_TPU_GRAD_ACCUM_STEPS": str(cfg.gradient_accumulation_steps),
+        "ACCELERATE_TPU_PARALLELISM": ",".join(
+            str(x)
+            for x in (
+                cfg.data_parallel_size,
+                cfg.fsdp_size,
+                cfg.stage_size,
+                cfg.sequence_size,
+                cfg.tensor_size,
+            )
+        ),
+    }
+    if cfg.debug:
+        env["ACCELERATE_TPU_DEBUG_MODE"] = "1"
+    if cfg.num_processes > 1:
+        env["ACCELERATE_TPU_NUM_PROCESSES"] = str(cfg.num_processes)
+        env["JAX_NUM_PROCESSES"] = str(cfg.num_processes)
+        env["JAX_PROCESS_ID"] = str(cfg.process_id)
+        if cfg.coordinator_address:
+            env["JAX_COORDINATOR_ADDRESS"] = cfg.coordinator_address
+    return env
+
+
+def _run_script(script: str, script_args: list[str], module: bool) -> None:
+    sys.argv = [script] + script_args
+    if module:
+        runpy.run_module(script, run_name="__main__")
+    else:
+        runpy.run_path(script, run_name="__main__")
+
+
+def _debug_cpu_launch(n: int, script: str, script_args: list[str], base_env: dict[str, str]) -> int:
+    """Fork n local JAX processes over a localhost coordinator (CPU platform)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update(base_env)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": str(n),
+                "JAX_PROCESS_ID": str(i),
+                "ACCELERATE_TPU_NUM_PROCESSES": str(n),
+            }
+        )
+        procs.append(subprocess.Popen([sys.executable, script, *script_args], env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_command(args: argparse.Namespace) -> None:
+    cfg = LaunchConfig.from_yaml(Path(args.config_file) if args.config_file else None)
+    # CLI overrides (flag > env > config file)
+    for attr in (
+        "num_processes",
+        "process_id",
+        "coordinator_address",
+        "mixed_precision",
+        "gradient_accumulation_steps",
+        "data_parallel_size",
+        "fsdp_size",
+        "tensor_size",
+        "sequence_size",
+        "stage_size",
+    ):
+        value = getattr(args, attr, None)
+        if value is not None:
+            setattr(cfg, attr, value)
+    if args.debug:
+        cfg.debug = True
+
+    env = launch_env(cfg)
+    if args.debug_cpu:
+        rc = _debug_cpu_launch(args.debug_cpu, args.training_script, args.training_script_args, env)
+        sys.exit(rc)
+    os.environ.update(env)
+    _run_script(args.training_script, args.training_script_args, module=args.module)
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("launch", help="launch a training script")
+    p.add_argument("--config_file", default=None)
+    p.add_argument("--num_processes", type=int, default=None, help="number of hosts")
+    p.add_argument("--process_id", type=int, default=None, help="this host's index")
+    p.add_argument("--coordinator_address", default=None, help="host0:port")
+    p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    p.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    p.add_argument("--data_parallel_size", "--dp", type=int, default=None, dest="data_parallel_size")
+    p.add_argument("--fsdp_size", "--fsdp", type=int, default=None, dest="fsdp_size")
+    p.add_argument("--tensor_size", "--tp", type=int, default=None, dest="tensor_size")
+    p.add_argument("--sequence_size", "--sp", type=int, default=None, dest="sequence_size")
+    p.add_argument("--stage_size", "--pp", type=int, default=None, dest="stage_size")
+    p.add_argument("--debug", action="store_true", help="enable collective shape verification")
+    p.add_argument("--debug_cpu", type=int, default=None, metavar="N",
+                   help="fork N local CPU 'hosts' over a localhost coordinator")
+    p.add_argument("--module", action="store_true", help="treat script as a python module")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(func=launch_command)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("accelerate-tpu-launch")
+    sub = parser.add_subparsers(dest="_cmd")
+    add_parser(sub)
+    argv = sys.argv[1:]
+    if argv and argv[0] != "launch":
+        argv = ["launch", *argv]
+    args = parser.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint.
+
+Capability parity: reference `commands/merge.py` over `merge_fsdp_weights`
+(`utils/fsdp_utils.py:274`): turn a distributed (orbax/tensorstore) checkpoint
+directory into a single-file consolidated export.
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+
+def merge_command(args: argparse.Namespace) -> None:
+    from ..checkpointing import _restore_pytree, save_model_weights
+
+    tree = _restore_pytree(Path(args.checkpoint_dir))
+    save_model_weights(tree, args.output_dir)
+    print(f"Merged {args.checkpoint_dir} -> {Path(args.output_dir) / 'model.msgpack'}")
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("merge-weights", help="merge a sharded checkpoint into one file")
+    p.add_argument("checkpoint_dir", help="orbax checkpoint directory (e.g. .../model_0)")
+    p.add_argument("output_dir")
+    p.set_defaults(func=merge_command)

@@ -1,0 +1,24 @@
+"""`accelerate-tpu test` — sanity-check the install by running the bundled
+end-to-end script through the launcher (reference `commands/test.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def test_command(args: argparse.Namespace) -> None:
+    from ..test_utils import test_script
+
+    from .config import LaunchConfig
+    from .launch import launch_env
+
+    cfg = LaunchConfig.from_yaml()
+    os.environ.update(launch_env(cfg))
+    test_script.main()
+    print("Test is a success! You are ready for your distributed training!")
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("test", help="run the bundled end-to-end sanity script")
+    p.set_defaults(func=test_command)

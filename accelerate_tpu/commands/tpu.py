@@ -1,0 +1,43 @@
+"""`accelerate-tpu tpu-config` — fan a command out to every worker of a TPU pod.
+
+Capability parity: reference `commands/tpu.py` (gcloud ssh --worker=all fan-out).
+Builds and (optionally) runs the gcloud command that starts `accelerate-tpu
+launch` on every pod VM — the pod-level process boundary the single-process-per-
+host model needs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+
+
+def build_gcloud_command(args: argparse.Namespace) -> list[str]:
+    inner = args.command or "accelerate-tpu launch " + (args.training_script or "")
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+        "--zone", args.zone,
+        "--worker", "all",
+        "--command", inner,
+    ]
+    if args.install_accelerate:
+        cmd[-1] = f"pip install accelerate-tpu; {inner}"
+    return cmd
+
+
+def tpu_command(args: argparse.Namespace) -> None:
+    cmd = build_gcloud_command(args)
+    print("Running:", " ".join(cmd))
+    if not args.dry_run:
+        subprocess.run(cmd, check=True)
+
+
+def add_parser(subparsers) -> None:
+    p = subparsers.add_parser("tpu-config", help="run a command on every TPU pod worker")
+    p.add_argument("--tpu_name", required=True)
+    p.add_argument("--zone", required=True)
+    p.add_argument("--command", default=None, help="full command to run on each worker")
+    p.add_argument("--training_script", default=None)
+    p.add_argument("--install_accelerate", action="store_true")
+    p.add_argument("--dry_run", action="store_true")
+    p.set_defaults(func=tpu_command)

@@ -1,0 +1,83 @@
+"""In-process launchers.
+
+Capability parity: reference `launchers.py` (302 LoC) — `notebook_launcher`
+(start distributed training from a notebook) and `debug_launcher` (multi-process
+CPU run for tests).
+
+TPU-native: inside a notebook on a TPU VM the devices are already attached to
+this process, so `notebook_launcher` just runs the function (per-core forking —
+xmp.spawn — is a torch_xla artifact with no JAX equivalent or need). Multi-*host*
+notebook launching is delegated to the CLI pod fan-out. `debug_launcher` forks
+real OS processes, each a JAX "host" on the CPU platform with a localhost
+coordinator — exercising the true multi-process collective path.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+from typing import Callable
+
+
+def notebook_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: int | None = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    **kwargs,
+) -> None:
+    """Run ``function(*args)`` on this host's devices (reference `launchers.py:40`)."""
+    os.environ.setdefault("ACCELERATE_TPU_MIXED_PRECISION", mixed_precision)
+    function(*args)
+
+
+def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2) -> None:
+    """Fork ``num_processes`` CPU 'hosts' over a localhost coordinator and run
+    ``function(*args)`` in each (reference `launchers.py:269` — 2-proc gloo CPU).
+
+    The function must be importable (defined in a module, not a closure): each
+    child imports it by qualified name, mirroring how torch's spawn pickles.
+    """
+    import socket
+
+    module = inspect.getmodule(function)
+    if module is None or not hasattr(module, "__file__"):
+        raise ValueError("debug_launcher requires a function defined in an importable module file")
+    fn_name = function.__qualname__
+    if "." in fn_name or "<locals>" in fn_name:
+        raise ValueError("debug_launcher requires a module-level function")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    runner = textwrap.dedent(
+        f"""
+        import runpy, sys
+        from accelerate_tpu.state import PartialState
+        PartialState()  # initialize jax.distributed from the env contract first
+        ns = runpy.run_path({module.__file__!r})
+        ns[{fn_name!r}](*{args!r})
+        """
+    )
+    procs = []
+    for i in range(num_processes):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                "JAX_NUM_PROCESSES": str(num_processes),
+                "JAX_PROCESS_ID": str(i),
+                "ACCELERATE_TPU_NUM_PROCESSES": str(num_processes),
+            }
+        )
+        procs.append(subprocess.Popen([sys.executable, "-c", runner], env=env))
+    codes = [p.wait() for p in procs]
+    if any(codes):
+        raise RuntimeError(f"debug_launcher children failed with exit codes {codes}")

@@ -51,14 +51,21 @@ def _maybe_init_distributed() -> None:
     ``jax.distributed.initialize()`` autodetects everything from metadata; the env
     vars only override. Mirrors the role of reference `state.py:212` init_process_group.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("ACCELERATE_TPU_NUM_PROCESSES")
     if coord is None and nproc is None:
         return
+    # NOTE: must not touch jax.devices()/process_count() here — that would
+    # initialize the backend single-process and make distributed init impossible
+    if jax.distributed.is_initialized():
+        return
+    pid = os.environ.get("JAX_PROCESS_ID")
     try:
-        jax.distributed.initialize()
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc) if nproc else None,
+            process_id=int(pid) if pid is not None else None,
+        )
     except (RuntimeError, ValueError) as e:  # already initialized or single-proc
         logger.debug("jax.distributed.initialize skipped: %s", e)
 

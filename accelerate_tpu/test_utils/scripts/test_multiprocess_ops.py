@@ -1,0 +1,30 @@
+"""Cross-process collective assertions, run under the debug/CLI launcher on N
+JAX processes (reference `test_utils/scripts/test_ops.py` pattern)."""
+
+def run_checks():
+    import jax
+    import numpy as np
+    assert jax.process_count() == 2, jax.process_count()
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import operations
+    state = PartialState()
+    assert state.num_processes == 2
+    # object all-gather across processes
+    got = operations.gather_object([f"proc{state.process_index}"])
+    assert got == ["proc0", "proc1"], got
+    # tensor gather across processes
+    x = np.full((2,), float(state.process_index))
+    g = operations.gather(x)
+    np.testing.assert_array_equal(np.asarray(g).ravel(), [0.0, 0.0, 1.0, 1.0])
+    # broadcast
+    b = operations.broadcast(np.full((3,), float(state.process_index + 5)), from_process=1)
+    np.testing.assert_array_equal(np.asarray(b), [6.0, 6.0, 6.0])
+    state.wait_for_everyone()
+    print(f"proc {state.process_index}: multihost collectives OK", flush=True)
+
+
+if __name__ == "__main__":
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks()

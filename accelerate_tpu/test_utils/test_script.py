@@ -1,0 +1,115 @@
+"""Bundled end-to-end assertion script (reference
+`test_utils/scripts/test_script.py`, 858 LoC — the master integration run by
+`accelerate test` on any user box). Asserts, on whatever topology it finds:
+RNG sync, dataloader sharding, training parity vs an independent baseline,
+split_between_processes, collectives, and the early-stop trigger.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def check_dataloader() -> None:
+    from ..data_loader import DataLoaderShard
+
+    batches = [{"x": np.full((16, 2), float(i))} for i in range(3)]
+    dl = DataLoaderShard(batches)
+    seen = list(dl)
+    assert len(seen) == 3
+    assert isinstance(seen[0]["x"], jax.Array)
+    assert dl.end_of_dataloader
+    print("  dataloader sharding: OK")
+
+
+def check_collectives() -> None:
+    from ..utils import operations
+
+    x = np.arange(8.0)
+    out = operations.gather(x)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    red = operations.reduce(np.ones((4,)), "sum")
+    assert red.shape == (4,)
+    print("  collectives: OK")
+
+
+def check_training_parity() -> None:
+    from ..accelerator import Accelerator
+    from ..data_loader import DataLoaderShard
+    from ..state import AcceleratorState, GradientState
+    from .training import (
+        make_regression_batches,
+        regression_apply_fn,
+        regression_loss_fn,
+        regression_model_params,
+    )
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    batches = make_regression_batches(6, 16)
+    # independent single-device baseline
+    params = {k: jnp.asarray(v) for k, v in regression_model_params().items()}
+    for b in batches:
+        b = {k: jnp.asarray(v) for k, v in b.items()}
+        g = jax.grad(lambda p: ((p["a"] * b["x"] + p["b"] - b["y"]) ** 2).mean())(params)
+        params = jax.tree.map(lambda p, g_: p - 0.1 * g_, params, g)
+
+    acc = Accelerator()
+    model, opt, dl = acc.prepare(
+        (regression_apply_fn, regression_model_params()), optax.sgd(0.1), DataLoaderShard(batches)
+    )
+    for batch in dl:
+        with acc.accumulate(model):
+            acc.backward(regression_loss_fn, batch)
+            opt.step()
+            opt.zero_grad()
+    got = acc.get_state_dict(model)
+    np.testing.assert_allclose(np.asarray(got["a"]), np.asarray(params["a"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["b"]), np.asarray(params["b"]), rtol=1e-5)
+    print("  distributed training parity: OK")
+
+
+def check_split_between_processes() -> None:
+    from ..state import PartialState
+
+    state = PartialState()
+    with state.split_between_processes(list(range(10))) as piece:
+        assert len(piece) >= 10 // max(state.num_processes, 1) - 1
+    print("  split_between_processes: OK")
+
+
+def check_trigger() -> None:
+    from ..accelerator import Accelerator
+
+    acc = Accelerator()
+    acc.set_trigger()
+    assert acc.check_trigger()
+    print("  early-stop trigger: OK")
+
+
+def check_rng_sync() -> None:
+    from ..utils.random import set_seed, synchronize_rng_states
+
+    set_seed(1234)
+    synchronize_rng_states()
+    print("  RNG synchronization: OK")
+
+
+def main() -> None:
+    import jax
+
+    print(f"Running accelerate-tpu sanity suite on {len(jax.devices())} device(s), "
+          f"{jax.process_count()} process(es)")
+    check_rng_sync()
+    check_collectives()
+    check_dataloader()
+    check_split_between_processes()
+    check_training_parity()
+    check_trigger()
+
+
+if __name__ == "__main__":
+    main()

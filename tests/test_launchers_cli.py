@@ -1,0 +1,117 @@
+"""Launcher + CLI tests: the tier-2 self-launched multi-process suite (reference
+`tests/test_multigpu.py` pattern) and config/launch arg plumbing."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestConfig:
+    def test_write_and_load_roundtrip(self, tmp_path):
+        from accelerate_tpu.commands.config import LaunchConfig
+
+        cfg = LaunchConfig(mixed_precision="bf16", fsdp_size=4, num_processes=2)
+        path = cfg.to_yaml(tmp_path / "cfg.yaml")
+        loaded = LaunchConfig.from_yaml(path)
+        assert loaded.mixed_precision == "bf16"
+        assert loaded.fsdp_size == 4
+        assert loaded.num_processes == 2
+
+    def test_missing_file_gives_defaults(self, tmp_path):
+        from accelerate_tpu.commands.config import LaunchConfig
+
+        cfg = LaunchConfig.from_yaml(tmp_path / "nope.yaml")
+        assert cfg.mixed_precision == "no"
+
+    def test_write_basic_config(self, tmp_path):
+        from accelerate_tpu.commands.config import write_basic_config
+
+        path = write_basic_config(mixed_precision="bf16", save_location=str(tmp_path / "c.yaml"))
+        assert path.exists()
+
+
+class TestLaunchEnv:
+    def test_env_contract(self):
+        from accelerate_tpu.commands.config import LaunchConfig
+        from accelerate_tpu.commands.launch import launch_env
+
+        cfg = LaunchConfig(
+            mixed_precision="bf16",
+            gradient_accumulation_steps=4,
+            fsdp_size=2,
+            tensor_size=2,
+            num_processes=4,
+            process_id=1,
+            coordinator_address="10.0.0.1:1234",
+        )
+        env = launch_env(cfg)
+        assert env["ACCELERATE_TPU_MIXED_PRECISION"] == "bf16"
+        assert env["ACCELERATE_TPU_GRAD_ACCUM_STEPS"] == "4"
+        assert env["ACCELERATE_TPU_PARALLELISM"] == "-1,2,1,1,2"
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:1234"
+        assert env["JAX_PROCESS_ID"] == "1"
+
+    def test_accelerator_reads_env_contract(self, monkeypatch):
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        monkeypatch.setenv("ACCELERATE_TPU_PARALLELISM", "2,2,1,1,2")
+        monkeypatch.setenv("ACCELERATE_TPU_GRAD_ACCUM_STEPS", "8")
+        acc = Accelerator()
+        assert acc.mesh.shape["fsdp"] == 2
+        assert acc.mesh.shape["tensor"] == 2
+        assert acc.gradient_accumulation_steps == 8
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+
+
+class TestCLI:
+    def _run(self, *args):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        return subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.cli", *args],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+
+    def test_env_command(self):
+        out = self._run("env")
+        assert out.returncode == 0
+        assert "jax" in out.stdout
+
+    def test_estimate_memory(self):
+        out = self._run("estimate-memory", "gpt2")
+        assert out.returncode == 0
+        assert "parameters" in out.stdout
+
+    def test_tpu_config_dry_run(self):
+        out = self._run(
+            "tpu-config", "--tpu_name", "t", "--zone", "z", "--command", "echo hi", "--dry_run"
+        )
+        assert out.returncode == 0
+        assert "gcloud" in out.stdout
+
+
+@pytest.mark.slow
+def test_multiprocess_ops_script():
+    """Tier-2: fork 2 real JAX processes over a localhost coordinator and run the
+    bundled cross-process collective assertions."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_multiprocess_ops
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_multiprocess_ops.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
