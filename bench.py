@@ -26,7 +26,7 @@ def main() -> None:
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     # GPT-2 small on one v5e chip; CPU fallback uses a tiny config so CI completes
     if on_tpu:
-        cfg = GPT2Config.small(dtype=jnp.bfloat16, attention_impl="xla", remat=False)
+        cfg = GPT2Config.small(dtype=jnp.bfloat16, attention_impl="flash", remat=False)
         batch, seq, iters = 8, 1024, 30
     else:
         cfg = GPT2Config.tiny(dtype=jnp.float32)
