@@ -90,6 +90,7 @@ class PreparedModel:
         self.module = module  # the original user object, for unwrap_model
         self._acc_grads = None  # used only when no optimizer is prepared
         self._jit_forward: Callable | None = None
+        self._hook = None  # hooks.ModelHook attachment point
         self.training = True
 
     @classmethod
@@ -124,7 +125,13 @@ class PreparedModel:
                 return policy.cast_to_output(out)
 
             self._jit_forward = jax.jit(fwd)
-        return self._jit_forward(self.params, args, kwargs)
+        params = self.params
+        if self._hook is not None:
+            params, args, kwargs = self._hook.pre_forward(self, params, args, kwargs)
+        out = self._jit_forward(params, args, kwargs)
+        if self._hook is not None:
+            out = self._hook.post_forward(self, out)
+        return out
 
     def eval(self) -> "PreparedModel":
         self.training = False

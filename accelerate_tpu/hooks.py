@@ -1,0 +1,124 @@
+"""Model hooks: pre/post-forward interception on prepared models.
+
+Capability parity: reference `src/accelerate/hooks.py` (720 LoC) — `ModelHook`,
+`SequentialHook`, `add_hook_to_module`, `AlignDevicesHook` (move weights to the
+execution device before forward, offload after).
+
+TPU-native re-founding: the reference monkey-patches ``module.forward``; here a
+hook wraps the *functional* call — `PreparedModel.__call__` consults its attached
+hook, and `pre_forward` may substitute the parameter pytree itself (which is how
+offloaded weights stream in: the hook hands back device-placed params, and
+`post_forward` drops them). No nn.Module surgery, no pickling hazards.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class ModelHook:
+    """Base hook (reference `hooks.py:37`). ``no_grad`` is meaningless under
+    functional transforms and omitted."""
+
+    def init_hook(self, model: Any) -> Any:
+        return model
+
+    def pre_forward(self, model: Any, params: Any, args: tuple, kwargs: dict):
+        """Return possibly-substituted (params, args, kwargs)."""
+        return params, args, kwargs
+
+    def post_forward(self, model: Any, output: Any) -> Any:
+        return output
+
+    def detach_hook(self, model: Any) -> Any:
+        return model
+
+
+class SequentialHook(ModelHook):
+    """Compose several hooks in order (reference `hooks.py:100`)."""
+
+    def __init__(self, *hooks: ModelHook):
+        self.hooks = list(hooks)
+
+    def init_hook(self, model):
+        for h in self.hooks:
+            model = h.init_hook(model)
+        return model
+
+    def pre_forward(self, model, params, args, kwargs):
+        for h in self.hooks:
+            params, args, kwargs = h.pre_forward(model, params, args, kwargs)
+        return params, args, kwargs
+
+    def post_forward(self, model, output):
+        for h in self.hooks:
+            output = h.post_forward(model, output)
+        return output
+
+    def detach_hook(self, model):
+        for h in self.hooks:
+            model = h.detach_hook(model)
+        return model
+
+
+def add_hook_to_module(model: Any, hook: ModelHook, append: bool = False) -> Any:
+    """Attach (or chain) a hook onto a PreparedModel-like object (reference
+    `hooks.py:124`)."""
+    existing = getattr(model, "_hook", None)
+    if append and existing is not None:
+        hook = SequentialHook(existing, hook)
+    model._hook = hook
+    return hook.init_hook(model)
+
+
+def remove_hook_from_module(model: Any, recurse: bool = False) -> Any:
+    hook = getattr(model, "_hook", None)
+    if hook is not None:
+        model = hook.detach_hook(model)
+        model._hook = None
+    return model
+
+
+class AlignDevicesHook(ModelHook):
+    """Stream weights to the execution device for the forward, release after
+    (reference `hooks.py:220`). ``weights_map`` is any mapping name->host array
+    (e.g. `OffloadedWeightsLoader`); restores device placement lazily per call."""
+
+    def __init__(
+        self,
+        execution_device: Any = None,
+        offload: bool = True,
+        weights_map: Any = None,
+        sharding: Any = None,
+    ):
+        self.execution_device = execution_device
+        self.offload = offload
+        self.weights_map = weights_map
+        self.sharding = sharding
+
+    def pre_forward(self, model, params, args, kwargs):
+        if self.weights_map is not None:
+            from .utils.modeling import unflatten_params
+
+            params = unflatten_params({k: self.weights_map[k] for k in self.weights_map})
+        target = self.sharding if self.sharding is not None else self.execution_device
+        if target is not None:
+            params = jax.tree.map(lambda p: jax.device_put(p, target), params)
+        self._live_params = params
+        return params, args, kwargs
+
+    def post_forward(self, model, output):
+        if self.offload:
+            # drop device copies; host masters stay in weights_map
+            params = getattr(self, "_live_params", None)
+            if params is not None:
+                jax.tree.map(
+                    lambda p: p.delete() if isinstance(p, jax.Array) and not p.is_deleted() else None,
+                    params,
+                    is_leaf=lambda x: isinstance(x, jax.Array),
+                )
+            self._live_params = None
+        return output

@@ -206,6 +206,48 @@ def lm_loss_fn(model, batch) -> jax.Array:
     return cross_entropy_loss(logits, labels)
 
 
+def gpt2_blockwise(config: GPT2Config):
+    """Decompose GPT-2 into sequential blocks for offload-streaming inference
+    (`big_modeling.BlockwiseModel`): embed -> block_i... -> head. Use with
+    `gpt2_blockwise_state_dict` to regroup a params tree into per-block subtrees."""
+    from ..big_modeling import BlockwiseModel
+
+    def embed_fn(p, input_ids):
+        s = input_ids.shape[1]
+        return p["wte"].astype(config.dtype)[input_ids] + p["wpe"].astype(config.dtype)[None, :s]
+
+    def make_block_fn(i):
+        def block_fn(p, x):
+            return Block(config, name=f"block_{i}").apply({"params": p}, x)
+
+        return block_fn
+
+    def head_fn(p, x):
+        x = nn.LayerNorm(epsilon=config.layer_norm_epsilon, dtype=jnp.float32).apply(
+            {"params": p["ln_f"]}, x
+        )
+        return jnp.einsum(
+            "bse,ve->bsv", x.astype(config.dtype), p["wte"].astype(config.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    fns = [("embed", embed_fn)]
+    fns += [(f"block_{i}", make_block_fn(i)) for i in range(config.n_layer)]
+    fns += [("head", head_fn)]
+    return BlockwiseModel(block_fns=fns)
+
+
+def gpt2_blockwise_state_dict(params: dict) -> dict:
+    """Regroup a GPT2LMHead param tree into the blockwise layout (the tied wte
+    appears in both embed and head groups, like the reference's tied-weight map)."""
+    out = {"embed": {"wte": params["wte"], "wpe": params["wpe"]}}
+    for k in params:
+        if k.startswith("block_"):
+            out[k] = params[k]
+    out["head"] = {"ln_f": params["ln_f"], "wte": params["wte"]}
+    return out
+
+
 def params_from_hf_gpt2(hf_state_dict: dict, config: GPT2Config) -> dict:
     """Map HuggingFace transformers GPT-2 torch weights into this layout.
 

@@ -1,0 +1,92 @@
+"""Disk-offloaded weight storage.
+
+Capability parity: reference `src/accelerate/utils/offload.py` (213 LoC) —
+numpy-memmap weight store with an ``index.json`` manifest, plus a dict-like
+loader that pulls from memory or disk transparently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections.abc import Mapping
+from pathlib import Path
+from typing import Any, Iterator
+
+import numpy as np
+
+
+def offload_weight(weight: np.ndarray, weight_name: str, offload_folder: str, index: dict | None = None) -> dict:
+    """Write one array to a .dat memmap and record it in the index
+    (reference `offload.py:25`)."""
+    weight = np.asarray(weight)
+    os.makedirs(offload_folder, exist_ok=True)
+    dtype = str(weight.dtype)
+    if weight.dtype == np.dtype("bfloat16"):  # numpy can't memmap bf16: store as uint16 bits
+        weight = weight.view(np.uint16)
+        dtype = "bfloat16"
+    path = Path(offload_folder) / f"{weight_name.replace('/', '--')}.dat"
+    mm = np.memmap(path, dtype=weight.dtype, mode="w+", shape=weight.shape or (1,))
+    mm[:] = weight if weight.shape else weight.reshape(1)
+    mm.flush()
+    if index is not None:
+        index[weight_name] = {"dtype": dtype, "shape": list(weight.shape)}
+    return index if index is not None else {}
+
+
+def save_offload_index(index: dict, offload_folder: str) -> None:
+    with open(Path(offload_folder) / "index.json", "w") as f:
+        json.dump(index, f, indent=2)
+
+
+def load_offload_index(offload_folder: str) -> dict:
+    with open(Path(offload_folder) / "index.json") as f:
+        return json.load(f)
+
+
+def load_offloaded_weight(offload_folder: str, weight_name: str, info: dict) -> np.ndarray:
+    shape = tuple(info["shape"]) or (1,)
+    dtype = info["dtype"]
+    storage_dtype = np.uint16 if dtype == "bfloat16" else np.dtype(dtype)
+    path = Path(offload_folder) / f"{weight_name.replace('/', '--')}.dat"
+    mm = np.memmap(path, dtype=storage_dtype, mode="r", shape=shape)
+    arr = np.asarray(mm)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        arr = arr.view(jnp.bfloat16)
+    if not info["shape"]:
+        arr = arr.reshape(())
+    return arr
+
+
+class OffloadedWeightsLoader(Mapping):
+    """Dict-like view over in-memory weights + a disk offload folder
+    (reference `OffloadedWeightsLoader`, `offload.py:127`)."""
+
+    def __init__(self, state_dict: dict[str, np.ndarray] | None = None, save_folder: str | None = None):
+        if state_dict is None and save_folder is None:
+            raise ValueError("Need at least one of state_dict or save_folder.")
+        self.state_dict = dict(state_dict or {})
+        self.save_folder = save_folder
+        self.index = load_offload_index(save_folder) if save_folder else {}
+        self.all_keys = list(self.state_dict) + [k for k in self.index if k not in self.state_dict]
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        if key in self.state_dict:
+            return self.state_dict[key]
+        return load_offloaded_weight(self.save_folder, key, self.index[key])
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.all_keys)
+
+    def __len__(self) -> int:
+        return len(self.all_keys)
+
+
+def offload_state_dict(save_dir: str, state_dict: dict[str, Any]) -> None:
+    """Offload a flat state dict to disk (reference `offload_state_dict`)."""
+    index: dict = {}
+    for name, value in state_dict.items():
+        index = offload_weight(np.asarray(value), name, save_dir, index)
+    save_offload_index(index, save_dir)

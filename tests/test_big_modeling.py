@@ -1,0 +1,164 @@
+"""Big-model inference stack: meta init, device-map solver, offload streaming,
+hooks (reference `tests/test_big_modeling.py` / `test_hooks.py` coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import (
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    infer_auto_device_map,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+)
+from accelerate_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHead,
+    gpt2_blockwise,
+    gpt2_blockwise_state_dict,
+)
+from accelerate_tpu.utils.modeling import (
+    calculate_maximum_sizes,
+    compute_module_sizes,
+    find_tied_parameters,
+    flatten_params,
+    unflatten_params,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt2():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    ids = jnp.asarray(np.arange(32).reshape(1, 32) % cfg.vocab_size, dtype=jnp.int32)
+    ref = module.apply({"params": params}, ids)
+    return cfg, module, params, ids, ref
+
+
+def test_init_empty_weights_no_allocation():
+    cfg = GPT2Config.tiny()
+    module = GPT2LMHead(cfg)
+    with init_empty_weights() as meta:
+        abstract = meta.init(module, jax.random.key(0), jnp.zeros((1, 8), dtype=jnp.int32))
+    leaves = jax.tree.leaves(abstract)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    assert len(leaves) > 10
+
+
+def test_flatten_unflatten_roundtrip(tiny_gpt2):
+    _, _, params, _, _ = tiny_gpt2
+    flat = flatten_params(params)
+    rebuilt = unflatten_params(flat)
+    for (ka, va), (kb, vb) in zip(
+        sorted(flatten_params(rebuilt).items()), sorted(flat.items())
+    ):
+        assert ka == kb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def test_module_sizes_and_maximum(tiny_gpt2):
+    _, _, params, _, _ = tiny_gpt2
+    sizes = compute_module_sizes(params)
+    total, (largest, name) = calculate_maximum_sizes(params)
+    assert sizes[""] == total
+    assert largest > 0 and name in flatten_params(params)
+    assert sizes["block_0"] > 0
+
+
+def test_find_tied_parameters():
+    shared = np.ones((4, 4))
+    params = {"a": {"w": shared}, "b": {"w": shared}, "c": np.zeros(2)}
+    ties = find_tied_parameters(params)
+    assert ties == [["a/w", "b/w"]]
+
+
+def test_infer_auto_device_map_tiers(tiny_gpt2):
+    _, _, params, _, _ = tiny_gpt2
+    sd = gpt2_blockwise_state_dict(params)
+    sizes = compute_module_sizes(sd)
+    # budget: only the embed block fits on device, one block on cpu, rest disk
+    budget = {
+        "device:0": sizes["embed"] + 1,
+        "cpu": sizes["block_0"] + 1,
+        "disk": 1 << 62,
+    }
+    dmap = infer_auto_device_map(sd, max_memory=budget)
+    assert dmap["embed"] == "device"
+    assert dmap["block_0"] == "cpu"
+    assert dmap["block_1"] == "disk"
+    assert dmap["head"] == "disk"
+
+
+@pytest.mark.parametrize("mode", ["device", "cpu", "disk", "mixed"])
+def test_blockwise_dispatch_matches_full(tiny_gpt2, tmp_path, mode):
+    cfg, module, params, ids, ref = tiny_gpt2
+    bw = gpt2_blockwise(cfg)
+    sd = gpt2_blockwise_state_dict(params)
+    names = [n for n, _ in bw.block_fns]
+    if mode == "device":
+        dmap = {n: "device" for n in names}
+    elif mode == "cpu":
+        dmap = {n: "cpu" for n in names}
+    elif mode == "disk":
+        dmap = {n: "disk" for n in names}
+    else:
+        dmap = {n: ("device" if i % 3 == 0 else "cpu" if i % 3 == 1 else "disk")
+                for i, n in enumerate(names)}
+    bw = dispatch_model(bw, dmap, sd, offload_dir=str(tmp_path / "offload"))
+    out = bw(ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_cpu_and_disk_offload_helpers(tiny_gpt2, tmp_path):
+    cfg, module, params, ids, ref = tiny_gpt2
+    sd = gpt2_blockwise_state_dict(params)
+    bw = cpu_offload(gpt2_blockwise(cfg), sd)
+    np.testing.assert_allclose(np.asarray(bw(ids)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    bw2 = disk_offload(gpt2_blockwise(cfg), sd, str(tmp_path / "disk"))
+    np.testing.assert_allclose(np.asarray(bw2(ids)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_load_checkpoint_and_dispatch(tiny_gpt2, tmp_path):
+    from accelerate_tpu.checkpointing import save_model_weights
+
+    cfg, module, params, ids, ref = tiny_gpt2
+    sd = gpt2_blockwise_state_dict(params)
+    save_model_weights(sd, str(tmp_path / "export"))
+    bw = load_checkpoint_and_dispatch(
+        gpt2_blockwise(cfg), str(tmp_path / "export"), device_map="auto",
+        offload_folder=str(tmp_path / "offload"),
+    )
+    np.testing.assert_allclose(np.asarray(bw(ids)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_hooks_on_prepared_model():
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.hooks import ModelHook, add_hook_to_module, remove_hook_from_module
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator()
+    model = acc.prepare_model((lambda p, x: p["w"] * x, {"w": np.asarray([2.0])}))
+
+    calls = []
+
+    class Doubler(ModelHook):
+        def pre_forward(self, model, params, args, kwargs):
+            calls.append("pre")
+            return jax.tree.map(lambda p: p * 2, params), args, kwargs
+
+        def post_forward(self, model, output):
+            calls.append("post")
+            return output + 1
+
+    add_hook_to_module(model, Doubler())
+    out = model(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(out), [13.0])  # (2*2)*3 + 1
+    assert calls == ["pre", "post"]
+    remove_hook_from_module(model)
+    np.testing.assert_allclose(np.asarray(model(jnp.asarray([3.0]))), [6.0])
