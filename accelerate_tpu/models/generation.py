@@ -1,0 +1,79 @@
+"""Autoregressive generation with KV cache.
+
+Capability role: the reference's big-model-inference benchmark surface is
+`model.generate` over dispatched checkpoints (BASELINE.md table); this is the
+TPU-native decode loop: prefill populates fixed-size KV caches, then a
+`lax.scan` emits one token per step — fully jitted, static shapes, cache buffers
+donated between steps.
+
+Works with any flax module accepting ``(input_ids, decode=..., position_offset=...)``
+and exposing a ``"cache"`` variable collection (see models/gpt2.py SelfAttention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(logits: jax.Array, key: jax.Array, temperature: float, top_k: int | None) -> jax.Array:
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5))
+def _generate_impl(module, params, input_ids, max_new_tokens, temperature, top_k, rng):
+    b, prompt_len = input_ids.shape
+    cache = module.init(jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True)["cache"]
+
+    # prefill the cache with the whole prompt in one pass
+    logits, mutated = module.apply(
+        {"params": params, "cache": cache}, input_ids, decode=True, position_offset=0,
+        mutable=["cache"],
+    )
+    cache = mutated["cache"]
+    rng, key = jax.random.split(rng)
+    token = _sample(logits[:, -1], key, temperature, top_k)
+
+    def step(carry, _):
+        cache, token, pos, rng = carry
+        logits, mutated = module.apply(
+            {"params": params, "cache": cache}, token[:, None], decode=True,
+            position_offset=pos, mutable=["cache"],
+        )
+        rng, key = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], key, temperature, top_k)
+        return (mutated["cache"], nxt, pos + 1, rng), token
+
+    (_, last, _, _), tokens = jax.lax.scan(
+        step, (cache, token, jnp.asarray(prompt_len), rng), None, length=max_new_tokens - 1
+    )
+    tokens = jnp.concatenate([tokens.T, last[:, None]], axis=1)  # [b, max_new_tokens]
+    return tokens
+
+
+def generate(
+    module: Any,
+    params: Any,
+    input_ids: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations for each prompt row.
+
+    temperature=0 is greedy; otherwise categorical sampling (optionally top-k).
+    Returns [batch, max_new_tokens] new tokens (prompt not repeated).
+    """
+    if rng is None:
+        rng = jax.random.key(0)
+    return _generate_impl(module, params, input_ids, int(max_new_tokens), float(temperature), top_k, rng)

@@ -73,7 +73,7 @@ class SelfAttention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False) -> jax.Array:
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.n_head
@@ -82,7 +82,33 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
         v = v.reshape(b, s, cfg.n_head, head_dim)
-        if cfg.attention_impl == "ring":
+        if decode:
+            # autoregressive KV cache (flax decode idiom): fixed n_positions-long
+            # buffers, new keys/values written at the running index
+            is_init = self.has_variable("cache", "cached_key")
+            max_len = cfg.n_positions
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros, (b, max_len, cfg.n_head, head_dim), k.dtype
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros, (b, max_len, cfg.n_head, head_dim), v.dtype
+            )
+            cache_idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            if is_init:
+                idx = cache_idx.value
+                k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+                v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+                cached_k.value = k_all
+                cached_v.value = v_all
+                cache_idx.value = idx + s
+                # query i (global pos idx+i) may attend cache slots <= idx+i
+                q_pos = idx + jnp.arange(s)[:, None]
+                kv_pos = jnp.arange(max_len)[None, :]
+                mask = kv_pos <= q_pos  # [s, max_len]
+                out = attention(q, k_all, v_all, causal=False, mask=mask, implementation="xla")
+            else:
+                out = attention(q, k, v, causal=True, implementation="xla")
+        elif cfg.attention_impl == "ring":
             # sequence-parallel exact attention over the mesh's ring axis
             from ..parallel.ring_attention import ring_attention_sharded
             from ..state import AcceleratorState
@@ -116,11 +142,11 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False) -> jax.Array:
         cfg = self.config
         # pre-norm transformer; LN statistics in fp32
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_1")(x)
-        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype), deterministic)
         return x
@@ -132,7 +158,13 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        deterministic: bool = True,
+        decode: bool = False,
+        position_offset: jax.Array | int = 0,
+    ) -> jax.Array:
         cfg = self.config
         b, s = input_ids.shape
         wte = self.param(
@@ -141,14 +173,15 @@ class GPT2LMHead(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), cfg.param_dtype
         )
-        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :s]
+        positions = position_offset + jnp.arange(s)
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[positions][None]
 
         block = Block
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic), None),
+                lambda mdl, carry, _: (mdl(carry, deterministic, decode), None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layer,
@@ -156,7 +189,7 @@ class GPT2LMHead(nn.Module):
             )(block(cfg, name="blocks"), x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"block_{i}")(x, deterministic)
+                x = block(cfg, name=f"block_{i}")(x, deterministic, decode)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
         # tied LM head: logits through the embedding matrix, fp32 accumulation
