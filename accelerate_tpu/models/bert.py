@@ -1,0 +1,164 @@
+"""BERT encoder family, TPU-first (for the nlp_example baseline —
+BASELINE.md configs[0]: BERT-base GLUE/MRPC).
+
+Same design rules as gpt2.py: bf16 compute / fp32 masters, fp32 LN + softmax
+statistics, attention via `ops.attention` (XLA-fused or Pallas flash), TP as
+sharding rules. Post-LN (original BERT) with learned word/position/type
+embeddings and a tanh pooler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-12
+    dropout: float = 0.0
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        return cls(**{**dict(vocab_size=1024, max_position_embeddings=128, hidden_size=64,
+                             num_layers=2, num_heads=2, intermediate_size=128), **kw})
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, attention_mask: jax.Array | None = None) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        head_dim = e // cfg.num_heads
+        qkv = nn.Dense(3 * e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, head_dim)
+        k = k.reshape(b, s, cfg.num_heads, head_dim)
+        v = v.reshape(b, s, cfg.num_heads, head_dim)
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1=token 0=pad -> [b, 1, 1(s broadcast), s] boolean keep-mask
+            mask = attention_mask[:, None, None, :].astype(bool)
+        out = dot_product_attention(q, k, v, mask=mask)
+        out = out.reshape(b, s, e)
+        return nn.Dense(e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="out")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, attention_mask: jax.Array | None = None) -> jax.Array:
+        cfg = self.config
+        # post-LN (original BERT): sublayer -> residual -> LayerNorm
+        attn = BertSelfAttention(cfg, name="attention")(x, attention_mask)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="ln_attn")(x + attn).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_up")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_down")(h)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="ln_mlp")(x + h).astype(cfg.dtype)
+        return x
+
+
+class BertEncoder(nn.Module):
+    """Returns (sequence_output [b,s,e], pooled_output [b,e])."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        token_type_ids: jax.Array | None = None,
+    ):
+        cfg = self.config
+        b, s = input_ids.shape
+        word = self.param("word_embeddings", nn.initializers.normal(0.02),
+                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (cfg.max_position_embeddings, cfg.hidden_size), cfg.param_dtype)
+        typ = self.param("token_type_embeddings", nn.initializers.normal(0.02),
+                         (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (word[input_ids] + pos[None, :s] + typ[token_type_ids]).astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="ln_embed")(x).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    """BERT + classification head; returns fp32 logits [b, num_labels]."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        token_type_ids: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.config
+        _, pooled = BertEncoder(cfg, name="bert")(input_ids, attention_mask, token_type_ids)
+        logits = nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                          name="classifier")(pooled.astype(jnp.float32))
+        return logits
+
+    def init_params(self, rng: jax.Array, batch: int = 2, seq: int = 64) -> Any:
+        ids = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, ids)["params"]
+
+
+def bert_sharding_rules() -> ShardingRules:
+    """Megatron-style TP for the encoder (same column/row pattern as GPT-2)."""
+    return ShardingRules(
+        rules=[
+            (r".*attention/qkv/kernel", P(None, "tensor")),
+            (r".*attention/out/kernel", P("tensor", None)),
+            (r".*mlp_up/kernel", P(None, "tensor")),
+            (r".*mlp_down/kernel", P("tensor", None)),
+            (r".*word_embeddings", P("tensor", None)),
+            (r".*(qkv|mlp_up)/bias", P("tensor")),
+        ]
+    )
+
+
+def classification_loss_fn(model, batch) -> jax.Array:
+    """Softmax CE over labels — usable with Accelerator.backward/make_train_step."""
+    logits = model(batch["input_ids"], batch.get("attention_mask"), batch.get("token_type_ids"))
+    labels = batch["labels"]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logprobs, labels[:, None], axis=-1).mean()

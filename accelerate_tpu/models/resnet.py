@@ -1,0 +1,105 @@
+"""ResNet family, TPU-first (for the cv_example baseline — BASELINE.md
+configs[1]: ResNet-50 image classification, DP over v5e-8).
+
+Convolutions map straight onto the MXU (XLA lowers NHWC convs to im2col-free
+systolic matmuls). Normalization is GroupNorm rather than BatchNorm: identical
+jit-side semantics in train and eval, no mutable running statistics to thread
+through the functional step, and no cross-replica batch-stat sync — the standard
+JAX substitution (BatchNorm's cross-device sync is a DDP-ism this framework
+doesn't need).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # resnet50
+    num_filters: int = 64
+    num_classes: int = 1000
+    bottleneck: bool = True
+    num_groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet18(cls, **kw) -> "ResNetConfig":
+        return cls(**{**dict(stage_sizes=(2, 2, 2, 2), bottleneck=False), **kw})
+
+    @classmethod
+    def resnet50(cls, **kw) -> "ResNetConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ResNetConfig":
+        return cls(**{**dict(stage_sizes=(1, 1), num_filters=8, num_classes=10,
+                             bottleneck=False, num_groups=4), **kw})
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    config: ResNetConfig
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        gn = lambda name: nn.GroupNorm(num_groups=min(cfg.num_groups, self.filters),
+                                       dtype=jnp.float32, param_dtype=cfg.param_dtype, name=name)
+        conv = lambda f, k, s, name: nn.Conv(f, (k, k), (s, s), padding="SAME", use_bias=False,
+                                             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+        residual = x
+        if cfg.bottleneck:
+            y = nn.relu(gn("gn1")(conv(self.filters, 1, 1, "conv1")(x)).astype(cfg.dtype))
+            y = nn.relu(gn("gn2")(conv(self.filters, 3, self.strides, "conv2")(y)).astype(cfg.dtype))
+            y = gn("gn3")(conv(4 * self.filters, 1, 1, "conv3")(y)).astype(cfg.dtype)
+            out_filters = 4 * self.filters
+        else:
+            y = nn.relu(gn("gn1")(conv(self.filters, 3, self.strides, "conv1")(x)).astype(cfg.dtype))
+            y = gn("gn2")(conv(self.filters, 3, 1, "conv2")(y)).astype(cfg.dtype)
+            out_filters = self.filters
+        if residual.shape[-1] != out_filters or self.strides != 1:
+            residual = gn("gn_proj")(
+                conv(out_filters, 1, self.strides, "conv_proj")(residual)
+            ).astype(cfg.dtype)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Returns fp32 logits [batch, num_classes]. Input NHWC."""
+
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.num_filters, (7, 7), (2, 2), padding="SAME", use_bias=False,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="conv_stem")(x)
+        x = nn.GroupNorm(num_groups=min(cfg.num_groups, cfg.num_filters), dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="gn_stem")(x).astype(cfg.dtype)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = ResNetBlock(cfg.num_filters * 2**i, cfg, strides, name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                        name="classifier")(x.astype(jnp.float32))
+
+    def init_params(self, rng: jax.Array, image_size: int = 224) -> Any:
+        return self.init(rng, jnp.zeros((1, image_size, image_size, 3)))["params"]
+
+
+def image_classification_loss_fn(model, batch) -> jax.Array:
+    logits = model(batch["image"])
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logprobs, batch["label"][:, None], axis=-1).mean()

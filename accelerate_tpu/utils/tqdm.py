@@ -1,0 +1,19 @@
+"""Main-process-only progress bars (reference `utils/tqdm.py`)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in tqdm that renders only on the main process."""
+    if not is_tqdm_available():
+        raise ImportError("tqdm is not installed; `pip install tqdm`.")
+    from tqdm import auto
+
+    from ..state import PartialState
+
+    disable = kwargs.pop("disable", False)
+    if main_process_only and not PartialState().is_main_process:
+        disable = True
+    return auto.tqdm(*args, disable=disable, **kwargs)
