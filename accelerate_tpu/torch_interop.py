@@ -1,0 +1,418 @@
+"""Torch nn.Module interop: run (and train) torch models on TPU via JAX.
+
+This is the reference's core promise — `accelerator.prepare(model)` for a torch
+`nn.Module` — and SURVEY.md §7's #1-ranked hard part. The reference keeps torch
+as the executor; here the module must become a *pure JAX function* so it can be
+jitted/sharded/differentiated on TPU. Strategy:
+
+  1. `torch.fx.symbolic_trace` captures the module's forward as an op graph
+     (HF transformers models trace via `transformers.utils.fx`).
+  2. Parameters/buffers are pulled out of the module into a numpy pytree
+     (dot-path keys), convertible to sharded jax arrays.
+  3. A graph interpreter replays the fx graph with JAX ops: an op table maps
+     `call_module` leaf types (Linear/LayerNorm/Embedding/Conv2d/...),
+     `call_function` (torch.add/matmul/F.gelu/...) and `call_method`
+     (view/permute/transpose/...) onto jnp equivalents.
+
+The resulting ``apply_fn(params, *args)`` is a first-class citizen: it works
+with `Accelerator.prepare`, `backward`, `make_train_step`, sharding rules, and
+checkpointing. Coverage is the standard layer vocabulary — exotic custom ops
+raise `UnsupportedTorchOp` with the node context so users know exactly what to
+port.
+
+Known limits: HuggingFace transformers models are not fx-traceable with some
+torch/transformers version combinations (their tracer's mask utilities vmap over
+proxies); for those, use the per-architecture weight mappers instead
+(`models.gpt2.params_from_hf_gpt2`) — same capability the reference's
+checkpoint-ingestion path provides, with a TPU-native model body.
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class UnsupportedTorchOp(NotImplementedError):
+    pass
+
+
+def _t2n(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def extract_params(module) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
+    """(parameters, buffers) as flat dot-path dicts (reference analogue: the
+    state_dict the reference moves device-to-device; here it leaves torch)."""
+    params = {name: _t2n(p) for name, p in module.named_parameters()}
+    buffers = {name: _t2n(b) for name, b in module.named_buffers()}
+    return params, buffers
+
+
+# --------------------------------------------------------------- module table
+def _linear(mod, params, x):
+    w = params["weight"]  # [out, in] torch layout
+    y = jnp.matmul(x, w.T)
+    if params.get("bias") is not None:
+        y = y + params["bias"]
+    return y
+
+
+def _embedding(mod, params, idx):
+    return params["weight"][idx]
+
+
+def _layer_norm(mod, params, x):
+    axes = tuple(range(-len(mod.normalized_shape), 0))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + mod.eps)
+    if params.get("weight") is not None:
+        y = y * params["weight"]
+    if params.get("bias") is not None:
+        y = y + params["bias"]
+    return y
+
+
+def _conv2d(mod, params, x):
+    # torch NCHW / OIHW
+    dn = jax.lax.conv_dimension_numbers(x.shape, params["weight"].shape, ("NCHW", "OIHW", "NCHW"))
+    pad = mod.padding if isinstance(mod.padding, str) else [(p, p) for p in mod.padding]
+    y = jax.lax.conv_general_dilated(
+        x, params["weight"], window_strides=mod.stride, padding=pad,
+        rhs_dilation=mod.dilation, dimension_numbers=dn, feature_group_count=mod.groups,
+    )
+    if params.get("bias") is not None:
+        y = y + params["bias"][None, :, None, None]
+    return y
+
+
+def _group_norm(mod, params, x):
+    n, c = x.shape[:2]
+    g = mod.num_groups
+    xg = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + mod.eps)).reshape(x.shape)
+    if params.get("weight") is not None:
+        shape = (1, c) + (1,) * (x.ndim - 2)
+        y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+    return y
+
+
+def _batch_norm(mod, params, x):
+    # inference semantics: running statistics (buffers)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    mean = params["running_mean"].reshape(shape)
+    var = params["running_var"].reshape(shape)
+    y = (x - mean) * jax.lax.rsqrt(var + mod.eps)
+    if params.get("weight") is not None:
+        y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+    return y
+
+
+def _max_pool2d(mod, params, x):
+    k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+    s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or mod.kernel_size,) * 2
+    p = mod.padding if isinstance(mod.padding, tuple) else (mod.padding,) * 2
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 1, *k), (1, 1, *s),
+        [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])],
+    )
+
+
+def _avg_pool2d(mod, params, x):
+    k = mod.kernel_size if isinstance(mod.kernel_size, tuple) else (mod.kernel_size,) * 2
+    s = mod.stride if isinstance(mod.stride, tuple) else (mod.stride or mod.kernel_size,) * 2
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, *k), (1, 1, *s), "VALID")
+    return summed / (k[0] * k[1])
+
+
+def _adaptive_avg_pool2d(mod, params, x):
+    out = mod.output_size if isinstance(mod.output_size, tuple) else (mod.output_size,) * 2
+    if out == (1, 1):
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    raise UnsupportedTorchOp(f"AdaptiveAvgPool2d{out}")
+
+
+def _dropout(mod, params, x, *a, **k):
+    return x  # eval semantics
+
+
+def _identity(mod, params, x):
+    return x
+
+
+def _mha(mod, params, q, k, v, **kwargs):
+    raise UnsupportedTorchOp("nn.MultiheadAttention: use explicit q/k/v layers")
+
+
+MODULE_TABLE: dict[str, Callable] = {
+    "Linear": _linear,
+    "Embedding": _embedding,
+    "LayerNorm": _layer_norm,
+    "Conv2d": _conv2d,
+    "GroupNorm": _group_norm,
+    "BatchNorm1d": _batch_norm,
+    "BatchNorm2d": _batch_norm,
+    "MaxPool2d": _max_pool2d,
+    "AvgPool2d": _avg_pool2d,
+    "AdaptiveAvgPool2d": _adaptive_avg_pool2d,
+    "Dropout": _dropout,
+    "Identity": _identity,
+    "ReLU": lambda m, p, x: jax.nn.relu(x),
+    "GELU": lambda m, p, x: jax.nn.gelu(x, approximate=getattr(m, "approximate", "none") != "none"),
+    "SiLU": lambda m, p, x: jax.nn.silu(x),
+    "Sigmoid": lambda m, p, x: jax.nn.sigmoid(x),
+    "Tanh": lambda m, p, x: jnp.tanh(x),
+    "Softmax": lambda m, p, x: jax.nn.softmax(x, axis=m.dim if m.dim is not None else -1),
+    "Flatten": lambda m, p, x: x.reshape(*x.shape[: m.start_dim], -1),
+    "MultiheadAttention": _mha,
+}
+
+
+# ------------------------------------------------------------- function table
+def _fn_softmax(x, dim=-1, **kw):
+    return jax.nn.softmax(x, axis=dim)
+
+
+def _fn_gelu(x, approximate="none"):
+    return jax.nn.gelu(x, approximate=approximate != "none")
+
+
+def _build_function_table():
+    import torch
+    import torch.nn.functional as F
+
+    return {
+        torch.add: jnp.add, operator.add: operator.add,
+        torch.sub: jnp.subtract, operator.sub: operator.sub,
+        torch.mul: jnp.multiply, operator.mul: operator.mul,
+        torch.div: jnp.divide, operator.truediv: operator.truediv,
+        operator.floordiv: operator.floordiv,
+        torch.matmul: jnp.matmul, operator.matmul: jnp.matmul,
+        torch.bmm: jnp.matmul,
+        torch.pow: jnp.power, operator.pow: operator.pow,
+        torch.exp: jnp.exp, torch.log: jnp.log, torch.sqrt: jnp.sqrt,
+        torch.rsqrt: jax.lax.rsqrt,
+        torch.tanh: jnp.tanh, torch.sigmoid: jax.nn.sigmoid,
+        torch.relu: jax.nn.relu, F.relu: jax.nn.relu,
+        F.gelu: _fn_gelu, F.silu: jax.nn.silu, F.sigmoid: jax.nn.sigmoid,
+        F.softmax: _fn_softmax, torch.softmax: _fn_softmax,
+        F.dropout: lambda x, *a, **k: x,
+        torch.cat: lambda tensors, dim=0: jnp.concatenate(tensors, axis=dim),
+        torch.stack: lambda tensors, dim=0: jnp.stack(tensors, axis=dim),
+        torch.transpose: lambda x, a, b: jnp.swapaxes(x, a, b),
+        torch.permute: lambda x, dims: jnp.transpose(x, dims),
+        torch.reshape: lambda x, shape: jnp.reshape(x, shape),
+        torch.flatten: lambda x, start_dim=0, end_dim=-1: _flatten(x, start_dim, end_dim),
+        torch.mean: _reduce(jnp.mean), torch.sum: _reduce(jnp.sum),
+        torch.max: lambda x, dim=None, **k: jnp.max(x, axis=dim),
+        torch.min: lambda x, dim=None, **k: jnp.min(x, axis=dim),
+        torch.unsqueeze: lambda x, dim: jnp.expand_dims(x, dim),
+        torch.squeeze: lambda x, dim=None: jnp.squeeze(x, axis=dim),
+        operator.getitem: _getitem,
+        torch.arange: lambda *a, **k: jnp.arange(*a),
+        torch.ones: lambda *a, **k: jnp.ones(a[0] if len(a) == 1 else a),
+        torch.zeros: lambda *a, **k: jnp.zeros(a[0] if len(a) == 1 else a),
+        torch.where: jnp.where,
+        torch.einsum: jnp.einsum,
+        F.linear: lambda x, w, b=None: jnp.matmul(x, w.T) + (b if b is not None else 0),
+        F.embedding: lambda idx, w, *a, **k: w[idx],
+        F.layer_norm: _fn_layer_norm,
+        F.scaled_dot_product_attention: _fn_sdpa,
+        getattr: getattr,
+    }
+
+
+def _flatten(x, start_dim=0, end_dim=-1):
+    nd = x.ndim
+    end = end_dim % nd
+    shape = x.shape[:start_dim] + (-1,) + x.shape[end + 1 :]
+    return x.reshape(shape)
+
+
+def _reduce(fn):
+    def wrapped(x, dim=None, keepdim=False, **kw):
+        return fn(x, axis=dim, keepdims=keepdim)
+
+    return wrapped
+
+
+def _getitem(obj, idx):
+    def fix(i):
+        if type(i).__module__.startswith("torch") and hasattr(i, "detach"):
+            return jnp.asarray(_t2n(i))
+        return i
+
+    if isinstance(idx, tuple):
+        idx = tuple(fix(i) for i in idx)
+    else:
+        idx = fix(idx)
+    return obj[idx]
+
+
+def _fn_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+    axes = tuple(range(-len(normalized_shape), 0))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _fn_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None, **kw):
+    d = q.shape[-1]
+    scale = 1.0 / math.sqrt(d) if scale is None else scale
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool))
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+# --------------------------------------------------------------- method table
+METHOD_TABLE: dict[str, Callable] = {
+    "view": lambda x, *shape: x.reshape(shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape),
+    "reshape": lambda x, *shape: x.reshape(shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple, list)) else shape),
+    "permute": lambda x, *dims: jnp.transpose(x, dims[0] if len(dims) == 1 and isinstance(dims[0], (tuple, list)) else dims),
+    "transpose": lambda x, a, b: jnp.swapaxes(x, a, b),
+    "contiguous": lambda x: x,
+    "flatten": _flatten,
+    "size": lambda x, dim=None: x.shape if dim is None else x.shape[dim],
+    "shape": lambda x: x.shape,
+    "mean": _reduce(jnp.mean),
+    "sum": _reduce(jnp.sum),
+    "softmax": lambda x, dim=-1: jax.nn.softmax(x, axis=dim),
+    "unsqueeze": lambda x, dim: jnp.expand_dims(x, dim),
+    "squeeze": lambda x, dim=None: jnp.squeeze(x, axis=dim),
+    "expand": lambda x, *sizes: jnp.broadcast_to(x, tuple(x.shape[i] if s == -1 else s for i, s in enumerate(sizes))),
+    "masked_fill": lambda x, mask, value: jnp.where(mask, value, x),
+    "to": lambda x, *a, **k: x,
+    "float": lambda x: x.astype(jnp.float32),
+    "type_as": lambda x, other: x.astype(other.dtype),
+    "split": lambda x, size, dim=0: tuple(jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
+    "chunk": lambda x, n, dim=0: tuple(jnp.array_split(x, n, axis=dim)),
+    "pow": jnp.power,
+    "clamp": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    "repeat": lambda x, *reps: jnp.tile(x, reps),
+    "t": lambda x: x.T,
+    "bool": lambda x: x.astype(bool),
+    "long": lambda x: x.astype(jnp.int32),
+    "detach": lambda x: jax.lax.stop_gradient(x),
+    "item": lambda x: x,
+    "mul": jnp.multiply, "add": jnp.add, "sub": jnp.subtract, "div": jnp.divide,
+    "matmul": jnp.matmul,
+}
+
+
+def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, dict[str, np.ndarray]]:
+    """Trace a torch nn.Module and return ``(apply_fn, params)`` ready for
+    `Accelerator.prepare((apply_fn, params))`.
+
+    ``apply_fn(params, *inputs)`` replays the traced graph with JAX ops. Buffers
+    are captured as constants (closed over); parameters stay differentiable.
+    """
+    import torch
+
+    module = module.eval()
+    try:
+        gm = torch.fx.symbolic_trace(module)
+    except Exception:
+        from transformers.utils import fx as hf_fx  # HF models need their tracer
+
+        gm = hf_fx.symbolic_trace(module)
+    params, buffers = extract_params(module)
+    fn_table = _build_function_table()
+    submodules = dict(gm.named_modules())
+
+    def apply_fn(params: dict, *args: Any) -> Any:
+        env: dict[str, Any] = {}
+        arg_iter = iter(args)
+
+        def lookup(prefix: str, store: dict) -> dict:
+            out = {}
+            for key, value in store.items():
+                if key.startswith(prefix + ".") and "." not in key[len(prefix) + 1 :]:
+                    out[key[len(prefix) + 1 :]] = value
+                elif prefix == "" and "." not in key:
+                    out[key] = value
+            return out
+
+        def materialize(node_arg):
+            if isinstance(node_arg, torch.fx.Node):
+                return env[node_arg.name]
+            if isinstance(node_arg, (list, tuple)):
+                return type(node_arg)(materialize(a) for a in node_arg)
+            if isinstance(node_arg, dict):
+                return {k: materialize(v) for k, v in node_arg.items()}
+            if type(node_arg).__module__.startswith("torch") and hasattr(node_arg, "detach"):
+                return jnp.asarray(_t2n(node_arg))
+            return node_arg
+
+        for node in gm.graph.nodes:
+            if node.op == "placeholder":
+                try:
+                    env[node.name] = next(arg_iter)
+                except StopIteration:
+                    env[node.name] = materialize(node.args[0]) if node.args else None
+            elif node.op == "get_attr":
+                target = node.target
+                if target in params:
+                    env[node.name] = params[target]
+                elif target in buffers:
+                    env[node.name] = jnp.asarray(buffers[target])
+                else:  # torch constants stored on the module
+                    obj = gm
+                    for part in target.split("."):
+                        obj = getattr(obj, part)
+                    env[node.name] = materialize(obj)
+            elif node.op == "call_module":
+                sub = submodules[node.target]
+                cls = type(sub).__name__
+                handler = MODULE_TABLE.get(cls)
+                if handler is None:
+                    raise UnsupportedTorchOp(f"module {cls} at {node.target}")
+                sub_params = {
+                    **{k: jnp.asarray(v) for k, v in lookup(node.target, buffers).items()},
+                    **lookup(node.target, params),
+                }
+                margs = [materialize(a) for a in node.args]
+                env[node.name] = handler(sub, sub_params, *margs)
+            elif node.op == "call_function":
+                handler = fn_table.get(node.target)
+                if handler is None:
+                    raise UnsupportedTorchOp(f"function {node.target}")
+                margs = [materialize(a) for a in node.args]
+                mkwargs = {k: materialize(v) for k, v in node.kwargs.items()}
+                mkwargs.pop("dtype", None)
+                mkwargs.pop("device", None)
+                env[node.name] = handler(*margs, **mkwargs)
+            elif node.op == "call_method":
+                handler = METHOD_TABLE.get(node.target)
+                if handler is None:
+                    raise UnsupportedTorchOp(f"method .{node.target}()")
+                margs = [materialize(a) for a in node.args]
+                mkwargs = {k: materialize(v) for k, v in node.kwargs.items()}
+                env[node.name] = handler(*margs, **mkwargs)
+            elif node.op == "output":
+                return materialize(node.args[0])
+        raise RuntimeError("fx graph had no output node")
+
+    return apply_fn, params
