@@ -1,0 +1,261 @@
+"""Llama family, TPU-first (BASELINE.md configs[4]: Llama-2-7B sharded inference).
+
+Modern decoder stack: RMSNorm (fp32 stats), rotary position embeddings, grouped-
+query attention, SwiGLU MLP, no biases. Same framework contracts as gpt2.py:
+bf16 compute / fp32 masters, flash/XLA/ring attention dispatch, KV-cache decode,
+Megatron-style TP as sharding rules (GQA-aware: KV heads shard with the tensor
+axis only when num_kv_heads divides it).
+
+HF interchange: `params_from_hf_llama` maps transformers LlamaForCausalLM
+weights into this layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 4096
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                             num_layers=32, num_heads=32, num_kv_heads=8,
+                             rope_theta=500000.0, max_position_embeddings=8192), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        return cls(**{**dict(vocab_size=256, max_position_embeddings=128, hidden_size=64,
+                             intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2), **kw})
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dtype = x.dtype
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(dtype)
+
+
+def rope_frequencies(head_dim: int, positions: jax.Array, theta: float) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables [*pos_shape, head_dim/2] in fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [b, s, h, d]; cos/sin: [s, d/2] (or broadcastable)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, decode: bool = False, position_offset: Any = 0) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        head_dim = e // cfg.num_heads
+        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype,
+                                         param_dtype=cfg.param_dtype, name=name)
+        q = dense(cfg.num_heads * head_dim, "q_proj")(x).reshape(b, s, cfg.num_heads, head_dim)
+        k = dense(cfg.num_kv_heads * head_dim, "k_proj")(x).reshape(b, s, cfg.num_kv_heads, head_dim)
+        v = dense(cfg.num_kv_heads * head_dim, "v_proj")(x).reshape(b, s, cfg.num_kv_heads, head_dim)
+
+        positions = position_offset + jnp.arange(s)
+        cos, sin = rope_frequencies(head_dim, positions, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        groups = cfg.num_heads // cfg.num_kv_heads
+
+        if decode:
+            is_init = self.has_variable("cache", "cached_key")
+            max_len = cfg.max_position_embeddings
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     (b, max_len, cfg.num_kv_heads, head_dim), k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     (b, max_len, cfg.num_kv_heads, head_dim), v.dtype)
+            cache_idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+            if is_init:
+                idx = cache_idx.value
+                k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+                v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+                cached_k.value, cached_v.value = k_all, v_all
+                cache_idx.value = idx + s
+                k_rep = jnp.repeat(k_all, groups, axis=2)
+                v_rep = jnp.repeat(v_all, groups, axis=2)
+                q_pos = idx + jnp.arange(s)[:, None]
+                mask = jnp.arange(max_len)[None, :] <= q_pos
+                out = attention(q, k_rep, v_rep, causal=False, mask=mask, implementation="xla")
+            else:
+                out = attention(q, jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2),
+                                causal=True, implementation="xla")
+        else:
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+            if cfg.attention_impl == "ring":
+                from ..parallel.ring_attention import ring_attention_sharded
+                from ..state import AcceleratorState
+
+                out = ring_attention_sharded(q, k, v, AcceleratorState().mesh, causal=True)
+            else:
+                out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
+        out = out.reshape(b, s, e)
+        return dense(e, "o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype,
+                                         param_dtype=cfg.param_dtype, name=name)
+        gate = dense(cfg.intermediate_size, "gate_proj")(x)
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        return dense(cfg.hidden_size, "down_proj")(jax.nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, decode: bool = False, position_offset: Any = 0) -> jax.Array:
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x), decode, position_offset
+        )
+        x = x + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x)
+        )
+        return x
+
+
+class LlamaForCausalLM(nn.Module):
+    """Returns fp32 logits [batch, seq, vocab]."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        deterministic: bool = True,
+        decode: bool = False,
+        position_offset: Any = 0,
+    ) -> jax.Array:
+        cfg = self.config
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed.astype(cfg.dtype)[input_ids]
+        block = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        lm_head = self.param("lm_head", nn.initializers.normal(0.02),
+                             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        return jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), lm_head.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def init_params(self, rng: jax.Array, batch: int = 2, seq: int = 16) -> Any:
+        return self.init(rng, jnp.zeros((batch, seq), dtype=jnp.int32))["params"]
+
+
+def llama_sharding_rules(config: LlamaConfig | None = None) -> ShardingRules:
+    """TP: q/gate/up column-parallel, o/down row-parallel, embeddings vocab-sharded.
+    KV projections shard on tensor only if num_kv_heads divides the degree —
+    callers with extreme TP should replicate KV (set rules accordingly)."""
+    return ShardingRules(
+        rules=[
+            (r".*attn/(q_proj|k_proj|v_proj)/kernel", P(None, "tensor")),
+            (r".*attn/o_proj/kernel", P("tensor", None)),
+            (r".*mlp/(gate_proj|up_proj)/kernel", P(None, "tensor")),
+            (r".*mlp/down_proj/kernel", P("tensor", None)),
+            (r".*embed_tokens", P("tensor", None)),
+            (r".*lm_head", P("tensor", None)),
+        ]
+    )
+
+
+def llama_loss_fn(model, batch) -> jax.Array:
+    from .gpt2 import cross_entropy_loss
+
+    logits = model(batch["input_ids"])
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    return cross_entropy_loss(logits, labels)
+
+
+def params_from_hf_llama(hf_state_dict: dict, config: LlamaConfig) -> dict:
+    """Map HF transformers LlamaForCausalLM weights into this layout (torch
+    Linear stores [out, in]; flax Dense kernels are [in, out] -> transpose)."""
+
+    def _np(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    def _lin(key):
+        return _np(hf_state_dict[key]).T
+
+    p: dict[str, Any] = {
+        "embed_tokens": _np(hf_state_dict["model.embed_tokens.weight"]),
+        "final_norm": {"scale": _np(hf_state_dict["model.norm.weight"])},
+        "lm_head": _np(hf_state_dict["lm_head.weight"]),
+    }
+    for i in range(config.num_layers):
+        hf = f"model.layers.{i}."
+        p[f"layer_{i}"] = {
+            "input_norm": {"scale": _np(hf_state_dict[hf + "input_layernorm.weight"])},
+            "post_attn_norm": {"scale": _np(hf_state_dict[hf + "post_attention_layernorm.weight"])},
+            "attn": {
+                "q_proj": {"kernel": _lin(hf + "self_attn.q_proj.weight")},
+                "k_proj": {"kernel": _lin(hf + "self_attn.k_proj.weight")},
+                "v_proj": {"kernel": _lin(hf + "self_attn.v_proj.weight")},
+                "o_proj": {"kernel": _lin(hf + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": _lin(hf + "mlp.gate_proj.weight")},
+                "up_proj": {"kernel": _lin(hf + "mlp.up_proj.weight")},
+                "down_proj": {"kernel": _lin(hf + "mlp.down_proj.weight")},
+            },
+        }
+    return p

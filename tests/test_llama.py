@@ -1,0 +1,101 @@
+"""Llama: forward vs HF transformers implementation, TP-sharded generation, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_loss_fn,
+    llama_sharding_rules,
+    params_from_hf_llama,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _fresh(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def test_forward_parity_with_hf_transformers():
+    """Random-init HF Llama vs our model with mapped weights: same logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM as HFLlama
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5, attention_bias=False, tie_word_embeddings=False,
+    )
+    hf_model = HFLlama(hf_cfg).eval()
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_position_embeddings=64, dtype=jnp.float32,
+    )
+    params = params_from_hf_llama(hf_model.state_dict(), cfg)
+    ids = torch.randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf_model(ids).logits.numpy()
+    ours = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids.numpy()))
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=3e-4, rtol=1e-3)
+
+
+def test_cached_generation_matches_nocache():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    params = module.init_params(jax.random.key(0))
+    prompt = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 6)), dtype=jnp.int32)
+    # no-cache greedy rollout
+    ids = prompt
+    ref = []
+    for _ in range(8):
+        logits = module.apply({"params": params}, ids)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        ref.append(nxt)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    ref = jnp.stack(ref, axis=1)
+    got = generate(module, params, prompt, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_tp_sharded_forward_matches_replicated():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    params = module.init_params(jax.random.key(1))
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 16)), dtype=jnp.int32)
+    ref = module.apply({"params": params}, ids)
+
+    acc = _fresh(
+        parallelism_config=ParallelismConfig(data_parallel_size=4, tensor_size=2),
+        sharding_rules=llama_sharding_rules(),
+    )
+    model = acc.prepare_model((module, params))
+    out = model(ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=1e-3)
+    # verify q_proj kernel actually sharded column-wise over tensor axis
+    kq = model.params["layer_0"]["attn"]["q_proj"]["kernel"]
+    assert kq.sharding.shard_shape(kq.shape)[1] == kq.shape[1] // 2
+
+
+def test_llama_trains():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    acc = _fresh()
+    module = LlamaForCausalLM(cfg)
+    params = module.init_params(jax.random.key(2))
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, (8, 1)).astype(np.int32)
+    batches = [{"input_ids": np.repeat(tokens, 16, axis=1)} for _ in range(6)]
+    model, opt, dl = acc.prepare((module, params), optax.adamw(1e-2), DataLoaderShard(batches))
+    step = acc.make_train_step(llama_loss_fn)
+    losses = [float(step(b)) for b in dl]
+    assert losses[-1] < losses[0]
