@@ -1,0 +1,82 @@
+"""Ulysses-style sequence parallelism: all-to-all head redistribution.
+
+Second long-context strategy alongside `ring_attention` (SURVEY.md §5: "Ulysses-
+style all-to-all head redistribution as the alternative when heads >= sequence
+shards"). Where ring attention keeps heads whole and rotates KV chunks, Ulysses
+transposes the parallelism: activations arrive sequence-sharded, an all-to-all
+regroups them to *head-sharded with full sequence*, each device runs ordinary
+(flash) attention on its head slice with the entire sequence visible, and a
+second all-to-all restores sequence sharding.
+
+Trade-offs on TPU: two all-to-alls per attention vs ring's (n-1) ppermutes; with
+heads % shards == 0 and moderate ring sizes the all-to-all rides ICI efficiently
+and composes with any attention kernel unchanged (no lse merging), but the ring
+scales to shard counts beyond the head count where Ulysses cannot.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+
+
+def _all_to_all_seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S/n, H, D] (sequence-sharded) -> [B, S, H/n, D] (head-sharded).
+
+    tiled all-to-all: the head dim splits into n groups (group j to device j) and
+    received sequence chunks concatenate in device order along the seq dim, so
+    global ordering of both axes is preserved."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _all_to_all_heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S, H/n, D] (head-sharded) -> [B, S/n, H, D] (sequence-sharded)."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,  # local [B, S/n, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sequence",
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Call inside shard_map with ``axis_name`` bound; requires H % n == 0."""
+    n = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"Ulysses needs heads ({h}) divisible by sequence shards ({n}).")
+    qh = _all_to_all_seq_to_heads(q, axis_name)
+    kh = _all_to_all_seq_to_heads(k, axis_name)
+    vh = _all_to_all_seq_to_heads(v, axis_name)
+    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    return _all_to_all_heads_to_seq(out, axis_name)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,  # global [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """shard_map wrapper over the sequence axis (same contract as
+    `ring_attention_sharded`)."""
+    if mesh.shape.get("sequence", 1) == 1:
+        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    from jax import shard_map
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    spec = P(batch_axes if batch_axes else None, "sequence", None, None)
+    fn = functools.partial(ulysses_attention, axis_name="sequence", causal=causal, scale=scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(
+        q, k, v
+    )
