@@ -1,0 +1,113 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Capability position: the reference's only MoE support is marking MoE classes as
+ZeRO-3 leaves for DeepSpeed (SURVEY.md §2.4 EP row — "not implemented"); this is
+the native TPU design. Switch/GShard-style top-k routing with static capacity:
+
+  - routing, dispatch and combine are one-hot einsums — static shapes, MXU-
+    friendly, no gather/scatter (the GSPMD MoE recipe).
+  - expert-stacked weights [E, in, out] shard their leading dim over the
+    ``tensor`` mesh axis (EP shares the TP axis, the common economical choice);
+    XLA inserts the token all-to-alls from the shardings.
+  - aux load-balancing loss (Switch Transformer) is sown into the
+    ``intermediates`` collection for the train step to pick up.
+
+Dropped tokens (over capacity) pass through the residual stream untouched, as in
+GShard/Switch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert MLP over [batch, seq, hidden] activations."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        n_tokens = b * s
+        E = cfg.num_experts
+        capacity = max(int(cfg.capacity_factor * n_tokens * cfg.top_k / E), 1)
+
+        xt = x.reshape(n_tokens, e)
+        # router in fp32 for stable softmax
+        router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                                 param_dtype=cfg.param_dtype, name="router")(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+
+        # top-k expert choice per token
+        gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, k]
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        # position of each token within its expert's capacity buffer; slots are
+        # processed in order, later slots offset by earlier slots' fill counts
+        dispatch = jnp.zeros((n_tokens, E, capacity), dtype=cfg.dtype)
+        combine = jnp.zeros((n_tokens, E, capacity), dtype=jnp.float32)
+        fill = jnp.zeros((E,), dtype=jnp.float32)
+        for slot in range(cfg.top_k):
+            onehot = jax.nn.one_hot(expert_idx[:, slot], E, dtype=jnp.float32)  # [T, E]
+            within = jnp.cumsum(onehot, axis=0) - onehot  # earlier tokens, this slot
+            pos_in_expert = jnp.sum((within + fill[None, :]) * onehot, axis=-1)  # [T]
+            keep = pos_in_expert < capacity
+            pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)  # [T, C]
+            contrib = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+            dispatch = dispatch + contrib.astype(cfg.dtype)
+            combine = combine + contrib * gate_vals[:, slot][:, None, None]
+            fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+
+        # expert-stacked weights: leading dim shards over the tensor axis (EP)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, e, cfg.intermediate_size), cfg.param_dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (E, cfg.intermediate_size, e), cfg.param_dtype)
+
+        # dispatch -> expert compute -> combine (all einsums; static shapes)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xt.astype(cfg.dtype))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cfg.dtype))
+        h = nn.gelu(h, approximate=True)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
+        out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), expert_out)
+
+        # Switch aux loss: fraction-routed x mean-prob per expert
+        me = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
+        ce = jnp.mean(probs, axis=0)
+        aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
+        self.sow("intermediates", "aux_loss", aux)
+        return out.reshape(b, s, e).astype(x.dtype)
+
+
+def moe_sharding_rules() -> ShardingRules:
+    """Expert parallelism: expert-stacked weights shard their leading (expert)
+    dim over the tensor axis; the router stays replicated."""
+    return ShardingRules(
+        rules=[
+            (r".*w_up", P("tensor", None, None)),
+            (r".*w_down", P("tensor", None, None)),
+            (r".*router.*", P(None, None)),
+        ]
+    )

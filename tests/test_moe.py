@@ -1,0 +1,119 @@
+"""MoE layer: routing correctness vs naive per-token loop, EP sharding, training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu.ops.moe import MoEConfig, MoEMLP, moe_sharding_rules
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _cfg(**kw):
+    return MoEConfig(**{**dict(num_experts=4, top_k=2, hidden_size=16, intermediate_size=32,
+                               capacity_factor=2.0, dtype=jnp.float32), **kw})
+
+
+def _naive_moe(params, x, cfg):
+    """Per-token loop reference (no capacity dropping when capacity is ample)."""
+    b, s, e = x.shape
+    xt = np.asarray(x).reshape(-1, e)
+    router = np.asarray(params["router"]["kernel"])
+    w_up = np.asarray(params["w_up"])
+    w_down = np.asarray(params["w_down"])
+    logits = xt @ router
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    out = np.zeros_like(xt)
+    for t in range(xt.shape[0]):
+        top = np.argsort(-probs[t])[: cfg.top_k]
+        gates = probs[t][top] / probs[t][top].sum()
+        for gate, eidx in zip(gates, top):
+            h = xt[t] @ w_up[eidx]
+            # approximate gelu to match nn.gelu(approximate=True)
+            h = 0.5 * h * (1 + np.tanh(np.sqrt(2 / np.pi) * (h + 0.044715 * h**3)))
+            out[t] += gate * (h @ w_down[eidx])
+    return out.reshape(b, s, e)
+
+
+def test_moe_matches_naive_loop():
+    cfg = _cfg()
+    module = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16))
+    params = module.init(jax.random.key(1), x)["params"]
+    out = module.apply({"params": params}, x)
+    ref = _naive_moe(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    cfg = _cfg(capacity_factor=0.25, top_k=1)
+    module = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.key(2), (2, 8, 16))
+    params = module.init(jax.random.key(3), x)["params"]
+    out = module.apply({"params": params}, x)
+    assert out.shape == x.shape
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_moe_aux_loss_sown():
+    cfg = _cfg()
+    module = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.key(4), (2, 8, 16))
+    params = module.init(jax.random.key(5), x)["params"]
+    _, inter = module.apply({"params": params}, x, mutable=["intermediates"])
+    aux = inter["intermediates"]["aux_loss"][0]
+    assert float(aux) > 0
+
+
+def test_moe_ep_sharded_matches_replicated():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    from accelerate_tpu.accelerator import Accelerator
+
+    cfg = _cfg()
+    module = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.key(6), (4, 8, 16))
+    params = module.init(jax.random.key(7), x)["params"]
+    ref = module.apply({"params": params}, x)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(data_parallel_size=2, tensor_size=4),
+        sharding_rules=moe_sharding_rules(),
+    )
+    model = acc.prepare_model(((lambda p, x: module.apply({"params": p}, x)), params))
+    out = model(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    # expert dim actually sharded
+    w = model.params["w_up"]
+    assert w.sharding.shard_shape(w.shape)[0] == cfg.num_experts // 4
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def test_moe_trains():
+    cfg = _cfg()
+    module = MoEMLP(cfg)
+    key = jax.random.key(8)
+    x = jax.random.normal(key, (4, 8, 16))
+    target = jnp.tanh(x) * 2.0
+    params = module.init(jax.random.key(9), x)["params"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, inter = module.apply({"params": p}, x, mutable=["intermediates"])
+            aux = inter["intermediates"]["aux_loss"][0]
+            return ((out - target) ** 2).mean() + aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
