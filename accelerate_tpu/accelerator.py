@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+from collections.abc import Mapping
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
@@ -50,7 +51,11 @@ def _is_flax_module(obj: Any) -> bool:
 
 class BoundModel:
     """A model with params bound — what user ``loss_fn(model, batch)`` receives.
-    Calling it runs the forward with those exact params, so gradients flow."""
+    Calling it runs the forward with those exact params, so gradients flow.
+
+    When the model carries mutable non-param collections (``batch_stats``,
+    ``fp8_meta``, …), each call threads them through and keeps the updated
+    state on ``self.extra_state`` for the train step to collect."""
 
     __slots__ = ("apply_fn", "params", "extra_state")
 
@@ -60,6 +65,11 @@ class BoundModel:
         self.extra_state = extra_state
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self.extra_state is not None:
+            out, self.extra_state = self.apply_fn(
+                self.params, *args, extra_state=self.extra_state, **kwargs
+            )
+            return out
         return self.apply_fn(self.params, *args, **kwargs)
 
 
@@ -81,6 +91,7 @@ class PreparedModel:
         mesh,
         shardings: Any,
         module: Any = None,
+        extra_state: Any = None,
     ):
         self.apply_fn = apply_fn
         self.params = params
@@ -88,47 +99,76 @@ class PreparedModel:
         self.mesh = mesh
         self.shardings = shardings
         self.module = module  # the original user object, for unwrap_model
+        self.extra_state = extra_state  # mutable non-param collections (replicated)
         self._acc_grads = None  # used only when no optimizer is prepared
         self._jit_forward: Callable | None = None
         self._hook = None  # hooks.ModelHook attachment point
         self.training = True
 
     @classmethod
-    def _extract(cls, obj: Any) -> tuple[Callable, Any, Any]:
-        """Normalize user model objects to (apply_fn, params, original)."""
+    def _extract(cls, obj: Any) -> tuple[Callable, Any, Any, Any]:
+        """Normalize user model objects to (apply_fn, params, extra_state, original).
+
+        ``extra_state`` is non-None when a flax ``variables`` dict with mutable
+        collections besides ``params`` (``batch_stats``, ``fp8_meta``, …) was
+        passed; the returned apply_fn then accepts ``extra_state=`` and returns
+        ``(out, new_extra_state)``.
+        """
         if isinstance(obj, tuple) and len(obj) == 2:
             fn_or_module, params = obj
             if _is_flax_module(fn_or_module):
                 module = fn_or_module
+                extra_state = None
+                if isinstance(params, Mapping) and "params" in params and len(params) > 1:
+                    extra_state = {k: dict(v) if isinstance(v, Mapping) else v
+                                   for k, v in params.items() if k != "params"}
+                    params = params["params"]
 
-                def apply_fn(p, *args, **kwargs):
+                def apply_fn(p, *args, extra_state=None, **kwargs):
+                    if extra_state is not None:
+                        out, mutated = module.apply(
+                            {"params": p, **extra_state},
+                            *args,
+                            mutable=list(extra_state.keys()),
+                            **kwargs,
+                        )
+                        return out, dict(mutated)
                     variables = {"params": p} if "params" not in p else p
                     return module.apply(variables, *args, **kwargs)
 
-                return apply_fn, params, module
+                return apply_fn, params, extra_state, module
             if callable(fn_or_module):
-                return fn_or_module, params, fn_or_module
+                return fn_or_module, params, None, fn_or_module
         raise TypeError(
             "Model must be a (flax_module, params) or (apply_fn, params) tuple, "
             f"got {type(obj)}. Initialize params first (module.init(key, sample))."
         )
 
     def bind(self, params: Any | None = None) -> BoundModel:
-        return BoundModel(self.apply_fn, self.params if params is None else params)
+        return BoundModel(
+            self.apply_fn, self.params if params is None else params, self.extra_state
+        )
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         if self._jit_forward is None:
             policy = self.policy
+            has_state = self.extra_state is not None
 
-            def fwd(params, args, kwargs):
-                out = self.apply_fn(policy.cast_to_compute(params), *args, **kwargs)
-                return policy.cast_to_output(out)
+            def fwd(params, state, args, kwargs):
+                p = policy.cast_to_compute(params)
+                if has_state:
+                    out, new_state = self.apply_fn(p, *args, extra_state=state, **kwargs)
+                else:
+                    out, new_state = self.apply_fn(p, *args, **kwargs), None
+                return policy.cast_to_output(out), new_state
 
             self._jit_forward = jax.jit(fwd)
         params = self.params
         if self._hook is not None:
             params, args, kwargs = self._hook.pre_forward(self, params, args, kwargs)
-        out = self._jit_forward(params, args, kwargs)
+        out, new_state = self._jit_forward(params, self.extra_state, args, kwargs)
+        if new_state is not None:
+            self.extra_state = new_state
         if self._hook is not None:
             out = self._hook.post_forward(self, out)
         return out
@@ -384,7 +424,7 @@ class Accelerator:
         `prepare_model`, `accelerator.py:1351-1593`, minus all engine wrapping)."""
         if isinstance(model, PreparedModel):
             return model
-        apply_fn, params, module = PreparedModel._extract(model)
+        apply_fn, params, extra_state, module = PreparedModel._extract(model)
         params = self.policy.cast_to_param(params)
         shardings = infer_param_shardings(
             params,
@@ -396,7 +436,13 @@ class Accelerator:
         if device_placement if device_placement is not None else self.device_placement:
             params = shard_params(params, shardings)
         prepared = PreparedModel(
-            apply_fn, params, policy=self.policy, mesh=self.mesh, shardings=shardings, module=module
+            apply_fn,
+            params,
+            policy=self.policy,
+            mesh=self.mesh,
+            shardings=shardings,
+            module=module,
+            extra_state=extra_state,
         )
         self._models.append(prepared)
         return prepared
@@ -491,17 +537,18 @@ class Accelerator:
             return self._grad_fns[key]
         policy = self.policy
 
-        def compute(params, batch, scale):
+        def compute(params, mstate, batch, scale):
             def scaled_loss(p):
-                out = loss_fn(BoundModel(model.apply_fn, policy.cast_to_compute(p)), batch)
+                bound = BoundModel(model.apply_fn, policy.cast_to_compute(p), mstate)
+                out = loss_fn(bound, batch)
                 if isinstance(out, tuple):
                     loss, aux = out[0], out[1:]
                 else:
                     loss, aux = out, ()
-                return (loss.astype(jnp.float32) * scale, (loss, aux))
+                return (loss.astype(jnp.float32) * scale, (loss, aux, bound.extra_state))
 
-            (_, (loss, aux)), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
-            return convert_to_fp32(loss), aux, grads
+            (_, (loss, aux, new_mstate)), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+            return convert_to_fp32(loss), aux, grads, new_mstate
 
         fn = jax.jit(compute)
         self._grad_fns[key] = fn
@@ -525,7 +572,10 @@ class Accelerator:
             opt = self._optimizer_for(model)
             if opt is not None and opt.scaler_state is not None:
                 scale = opt.scaler_state.scale * scale
-        loss, aux, grads = grad_fn(model.params, batch, jnp.asarray(scale, dtype=jnp.float32))
+        loss, aux, grads, new_mstate = grad_fn(
+            model.params, model.extra_state, batch, jnp.asarray(scale, dtype=jnp.float32)
+        )
+        model.extra_state = new_mstate
         opt = self._optimizer_for(model)
         if opt is not None:
             opt.accumulate_grads(grads)
@@ -603,47 +653,54 @@ class Accelerator:
         tx = optimizer.optimizer
         k = self.gradient_state.num_steps
 
-        def loss_and_grads(params, batch):
+        def loss_and_grads(params, mstate, batch):
+            # mstate = mutable non-param collections (batch_stats/fp8_meta/…),
+            # threaded through as value_and_grad aux — None for pure models.
             def f(p):
-                out = loss_fn(BoundModel(model.apply_fn, policy.cast_to_compute(p)), batch)
+                bound = BoundModel(model.apply_fn, policy.cast_to_compute(p), mstate)
+                out = loss_fn(bound, batch)
                 loss = out[0] if isinstance(out, tuple) else out
-                return loss.astype(jnp.float32) / k
+                return loss.astype(jnp.float32) / k, bound.extra_state
 
-            return jax.value_and_grad(f)(params)
+            (loss, new_mstate), grads = jax.value_and_grad(f, has_aux=True)(params)
+            return loss, grads, new_mstate
 
         @jax.jit
-        def micro_step(params, acc, batch):
-            loss, grads = loss_and_grads(params, batch)
+        def micro_step(params, mstate, acc, batch):
+            loss, grads, mstate = loss_and_grads(params, mstate, batch)
             acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
-            return acc, loss * k
+            return acc, mstate, loss * k
 
-        def _update(params, opt_state, acc, batch):
-            loss, grads = loss_and_grads(params, batch)
+        def _update(params, opt_state, mstate, acc, batch):
+            loss, grads, mstate = loss_and_grads(params, mstate, batch)
             if acc is not None:
                 grads = jax.tree.map(jnp.add, acc, grads)
             if max_grad_norm is not None:
                 grads, _ = _clip_tree(grads, max_grad_norm)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, loss * k
+            return params, opt_state, mstate, loss * k
 
-        update_step = jax.jit(_update, donate_argnums=(0, 1, 2) if donate else ())
+        update_step = jax.jit(_update, donate_argnums=(0, 1, 2, 3) if donate else ())
         # separate variant for the common k==1 case (no dead acc argument)
         state_box = {"acc": None, "count": 0}
 
         def step(batch: Any) -> jax.Array:
             self._do_sync()
             if self.gradient_state.sync_gradients:
-                params, opt_state, loss = update_step(
-                    model.params, optimizer.opt_state, state_box["acc"], batch
+                params, opt_state, mstate, loss = update_step(
+                    model.params, optimizer.opt_state, model.extra_state, state_box["acc"], batch
                 )
                 model.params = params
                 optimizer.opt_state = opt_state
+                model.extra_state = mstate
                 optimizer._num_updates += 1
                 state_box["acc"] = None
                 state_box["count"] = 0
             else:
-                state_box["acc"], loss = micro_step(model.params, state_box["acc"], batch)
+                state_box["acc"], model.extra_state, loss = micro_step(
+                    model.params, model.extra_state, state_box["acc"], batch
+                )
                 state_box["count"] += 1
             return loss
 

@@ -106,6 +106,8 @@ def save_accelerator_state(accelerator, output_dir: str | None = None) -> str:
 
     for i, model in enumerate(accelerator._models):
         _save_pytree(out / f"{MODEL_NAME}_{i}", model.params)
+        if getattr(model, "extra_state", None) is not None:
+            _save_pytree(out / f"{MODEL_NAME}_{i}.extra", model.extra_state)
     for i, opt in enumerate(accelerator._optimizers):
         sd = opt.state_dict()
         _save_pytree(out / f"{OPTIMIZER_NAME}_{i}", sd["opt_state"])
@@ -143,6 +145,9 @@ def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
 
     for i, model in enumerate(accelerator._models):
         model.params = _restore_pytree(src / f"{MODEL_NAME}_{i}", target=model.params)
+        extra_path = src / f"{MODEL_NAME}_{i}.extra"
+        if extra_path.exists() and getattr(model, "extra_state", None) is not None:
+            model.extra_state = _restore_pytree(extra_path, target=model.extra_state)
     for i, opt in enumerate(accelerator._optimizers):
         opt_state = _restore_pytree(src / f"{OPTIMIZER_NAME}_{i}", target=opt.opt_state)
         meta_path = src / f"{OPTIMIZER_NAME}_{i}.meta.pkl"

@@ -1,0 +1,11 @@
+"""TPU-native compute ops: attention (XLA + Pallas flash), MoE, fp8 matmul."""
+
+from .attention import attention, causal_mask, dot_product_attention  # noqa: F401
+from .fp8 import (  # noqa: F401
+    DelayedScalingRecipe,
+    Fp8Dense,
+    convert_dense_to_fp8,
+    fp8_dot,
+    quantize_dequantize,
+)
+from .moe import MoEConfig, MoEMLP, collect_aux_losses, moe_sharding_rules  # noqa: F401

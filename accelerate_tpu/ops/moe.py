@@ -10,7 +10,10 @@ the native TPU design. Switch/GShard-style top-k routing with static capacity:
     ``tensor`` mesh axis (EP shares the TP axis, the common economical choice);
     XLA inserts the token all-to-alls from the shardings.
   - aux load-balancing loss (Switch Transformer) is sown into the
-    ``intermediates`` collection for the train step to pick up.
+    ``intermediates`` collection; include ``"intermediates": {}`` in the
+    variables passed to ``Accelerator.prepare`` and add
+    ``collect_aux_losses(model.extra_state)`` — or, inside ``loss_fn``,
+    ``collect_aux_losses(m.extra_state)`` — to the loss.
 
 Dropped tokens (over capacity) pass through the residual stream untouched, as in
 GShard/Switch.
@@ -18,6 +21,7 @@ GShard/Switch.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from dataclasses import dataclass
 from typing import Any
 
@@ -74,7 +78,7 @@ class MoEMLP(nn.Module):
             within = jnp.cumsum(onehot, axis=0) - onehot  # earlier tokens, this slot
             pos_in_expert = jnp.sum((within + fill[None, :]) * onehot, axis=-1)  # [T]
             keep = pos_in_expert < capacity
-            pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)  # [T, C]
+            pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T, C]
             contrib = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
             dispatch = dispatch + contrib.astype(cfg.dtype)
             combine = combine + contrib * gate_vals[:, slot][:, None, None]
@@ -93,12 +97,48 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
         out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), expert_out)
 
-        # Switch aux loss: fraction-routed x mean-prob per expert
+        # Switch aux loss: fraction-routed x mean-prob per expert. Sown with an
+        # overwrite-reduce so the collection keeps a stable pytree structure
+        # across steps (tuple-append sow would grow and force recompiles when
+        # the collection is threaded through the train step as extra_state).
         me = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
         ce = jnp.mean(probs, axis=0)
         aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
-        self.sow("intermediates", "aux_loss", aux)
+        self.sow(
+            "intermediates",
+            "aux_loss",
+            aux,
+            reduce_fn=lambda prev, new: new,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
         return out.reshape(b, s, e).astype(x.dtype)
+
+
+def collect_aux_losses(extra_state: Any) -> jax.Array:
+    """Sum every sown ``aux_loss`` leaf out of a mutable-state pytree.
+
+    Usage inside a loss_fn driven by `Accelerator.make_train_step`:
+    ``loss = task_loss + collect_aux_losses(m.extra_state)`` (the BoundModel's
+    ``extra_state`` holds the post-forward ``intermediates`` collection when
+    the user passed one in their variables).
+    """
+    total = jnp.zeros((), jnp.float32)
+    if not extra_state:
+        return total
+    inter = extra_state.get("intermediates", extra_state)
+    for val in _aux_loss_leaves(inter):
+        total = total + jnp.sum(jnp.asarray(val, jnp.float32))
+    return total
+
+
+def _aux_loss_leaves(tree: Any):
+    """Yield every leaf stored under a key named 'aux_loss'."""
+    if isinstance(tree, Mapping):
+        for k, v in tree.items():
+            if k == "aux_loss":
+                yield from jax.tree.leaves(v)
+            else:
+                yield from _aux_loss_leaves(v)
 
 
 def moe_sharding_rules() -> ShardingRules:

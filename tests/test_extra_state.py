@@ -1,0 +1,124 @@
+"""Mutable-collection (extra_state) threading through the Accelerator facade:
+batch_stats/fp8_meta/intermediates survive make_train_step, backward(), eager
+forward, and checkpoint round-trips. (The reference has no analogue — torch
+modules mutate buffers in place; functional JAX must thread them explicitly.)"""
+
+import tempfile
+
+import flax.core
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.ops import Fp8Dense, MoEConfig, MoEMLP, collect_aux_losses
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _fresh(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+class Fp8MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = Fp8Dense(32, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        return Fp8Dense(1, dtype=jnp.float32)(x)
+
+
+def _data(n=64, bs=16):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 16)).astype(np.float32)
+    Y = (X @ rng.normal(size=(16, 1))).astype(np.float32)
+    return [{"x": X[i : i + bs], "y": Y[i : i + bs]} for i in range(0, n, bs)], X
+
+
+def _loss(m, b):
+    return jnp.mean((m(b["x"]) - b["y"]) ** 2)
+
+
+def test_train_step_threads_fp8_meta():
+    batches, X = _data()
+    acc = _fresh()
+    model = Fp8MLP()
+    variables = model.init(jax.random.key(0), X[:4])
+    pm, opt, dl = acc.prepare((model, variables), optax.adam(1e-2), DataLoaderShard(batches * 5))
+    step = acc.make_train_step(_loss)
+    losses = [float(step(b)) for b in dl]
+    assert losses[-1] < losses[0] * 0.3
+    scale = float(pm.extra_state["fp8_meta"]["Fp8Dense_0"]["input"]["scale"])
+    assert scale != 1.0  # delayed scaling actually adapted
+
+
+def test_backward_facade_threads_state():
+    batches, X = _data()
+    acc = _fresh()
+    model = Fp8MLP()
+    variables = model.init(jax.random.key(0), X[:4])
+    pm, opt, dl = acc.prepare((model, variables), optax.adam(1e-2), DataLoaderShard(batches * 3))
+    for b in dl:
+        acc.backward(_loss, b, model=pm)
+        opt.step()
+        opt.zero_grad()
+    assert float(pm.extra_state["fp8_meta"]["Fp8Dense_0"]["input"]["scale"]) != 1.0
+
+
+def test_frozendict_variables_accepted():
+    _, X = _data()
+    acc = _fresh()
+    model = Fp8MLP()
+    variables = flax.core.FrozenDict(model.init(jax.random.key(1), X[:4]))
+    pm = acc.prepare_model((model, variables))
+    assert pm.extra_state is not None
+    out = pm(X[:4])
+    assert out.shape == (4, 1)
+
+
+def test_checkpoint_round_trips_extra_state():
+    batches, X = _data()
+    acc = _fresh()
+    model = Fp8MLP()
+    variables = model.init(jax.random.key(2), X[:4])
+    pm, opt, dl = acc.prepare((model, variables), optax.adam(1e-2), DataLoaderShard(batches * 3))
+    step = acc.make_train_step(_loss)
+    for b in dl:
+        step(b)
+    trained = float(pm.extra_state["fp8_meta"]["Fp8Dense_0"]["input"]["scale"])
+    with tempfile.TemporaryDirectory() as td:
+        path = acc.save_state(td + "/ckpt")
+        pm.extra_state = jax.tree.map(jnp.zeros_like, pm.extra_state)
+        acc.load_state(path)
+    assert float(pm.extra_state["fp8_meta"]["Fp8Dense_0"]["input"]["scale"]) == trained != 0.0
+
+
+def test_moe_aux_loss_reachable_and_stable():
+    batches, X = _data()
+
+    class MoENet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(16)(x)[:, None, :]
+            h = MoEMLP(
+                MoEConfig(num_experts=4, top_k=2, hidden_size=16, intermediate_size=32, dtype=jnp.float32)
+            )(h)
+            return nn.Dense(1)(h[:, 0, :])
+
+    acc = _fresh()
+    model = MoENet()
+    init_vars = model.init(jax.random.key(3), X[:4])
+    variables = {"params": init_vars["params"], "intermediates": {}}
+    pm, opt, dl = acc.prepare((model, variables), optax.adam(1e-2), DataLoaderShard(batches * 5))
+
+    def loss_moe(m, b):
+        return _loss(m, b) + collect_aux_losses(m.extra_state)
+
+    step = acc.make_train_step(loss_moe)
+    losses = [float(step(b)) for b in dl]
+    assert losses[-1] < losses[0]
+    assert float(collect_aux_losses(pm.extra_state)) > 0.0

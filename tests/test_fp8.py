@@ -1,0 +1,121 @@
+"""fp8 q-dq matmul + delayed scaling (reference `utils/transformer_engine.py`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.ops.fp8 import (
+    DelayedScalingRecipe,
+    E4M3,
+    E4M3_MAX,
+    Fp8Dense,
+    convert_dense_to_fp8,
+    fp8_dot,
+    new_meta,
+    quantize_dequantize,
+    _update_meta,
+)
+
+
+def test_qdq_rounds_to_fp8_grid():
+    x = jnp.asarray([1.0, 0.1, -3.3, 400.0], jnp.float32)
+    out = quantize_dequantize(x, jnp.float32(1.0), E4M3, E4M3_MAX)
+    # every output must be exactly representable in e4m3 at scale 1
+    regrid = out.astype(E4M3).astype(jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(regrid))
+    # and close to the input at e4m3's relative precision (2^-3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0.07)
+
+
+def test_qdq_clips_overflow():
+    x = jnp.asarray([1e6, -1e6], jnp.float32)
+    out = quantize_dequantize(x, jnp.float32(1.0), E4M3, E4M3_MAX)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.max(np.abs(np.asarray(out))) <= E4M3_MAX
+
+
+def test_fp8_dot_close_to_exact():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    exact = x @ w
+    got = fp8_dot(x, w, jnp.float32(E4M3_MAX / 4.0), jnp.float32(E4M3_MAX / 4.0), False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exact), rtol=0.12, atol=0.4)
+
+
+def test_fp8_dot_grads_close_to_exact():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+
+    def loss_fp8(x, w):
+        return jnp.sum(fp8_dot(x, w, jnp.float32(100.0), jnp.float32(100.0), False) ** 2)
+
+    def loss_exact(x, w):
+        return jnp.sum((x @ w) ** 2)
+
+    gx, gw = jax.grad(loss_fp8, argnums=(0, 1))(x, w)
+    ex, ew = jax.grad(loss_exact, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ex), rtol=0.25, atol=1.5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(ew), rtol=0.25, atol=1.5)
+
+
+def test_delayed_scaling_meta_update():
+    meta = new_meta(4)
+    x = jnp.full((3, 3), 2.0, jnp.float32)
+    meta = _update_meta(meta, x, E4M3_MAX, margin=0)
+    assert float(meta["amax_history"][0]) == 2.0
+    np.testing.assert_allclose(float(meta["scale"]), E4M3_MAX / 2.0, rtol=1e-6)
+    # rolling: a new larger amax dominates
+    meta = _update_meta(meta, jnp.full((2,), 8.0, jnp.float32), E4M3_MAX, margin=0)
+    np.testing.assert_allclose(float(meta["scale"]), E4M3_MAX / 8.0, rtol=1e-6)
+
+
+def test_fp8_dense_forward_and_meta_threading():
+    layer = Fp8Dense(features=8, dtype=jnp.float32, recipe=DelayedScalingRecipe(amax_history_len=4))
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 5, 16)), jnp.float32)
+    variables = layer.init(jax.random.key(0), x)
+    assert "fp8_meta" in variables
+    out, mutated = layer.apply(variables, x, mutable=["fp8_meta"])
+    assert out.shape == (4, 5, 8)
+    # amax history actually rolled
+    assert float(mutated["fp8_meta"]["input"]["amax_history"][0]) > 0.0
+
+
+def test_fp8_dense_trains_regression():
+    rng = np.random.default_rng(3)
+    w_true = rng.normal(size=(16, 1))
+    x = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    y = jnp.asarray(x @ w_true, jnp.float32)
+
+    layer = Fp8Dense(features=1, dtype=jnp.float32)
+    variables = layer.init(jax.random.key(1), x)
+    params, meta = variables["params"], variables["fp8_meta"]
+
+    @jax.jit
+    def step(params, meta, x, y):
+        def f(p):
+            pred, new_vars = layer.apply(
+                {"params": p, "fp8_meta": meta}, x, mutable=["fp8_meta"]
+            )
+            return jnp.mean((pred - y) ** 2), new_vars["fp8_meta"]
+
+        (loss, new_meta_), grads = jax.value_and_grad(f, has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - 0.05 * g, params, grads)
+        return params, new_meta_, loss
+
+    losses = []
+    for _ in range(60):
+        params, meta, loss = step(params, meta, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_convert_factory():
+    import flax.linen as nn
+
+    plain = convert_dense_to_fp8(None)(4)
+    assert isinstance(plain, nn.Dense)
+    f8 = convert_dense_to_fp8(DelayedScalingRecipe())(4)
+    assert isinstance(f8, Fp8Dense)

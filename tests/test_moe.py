@@ -63,7 +63,7 @@ def test_moe_aux_loss_sown():
     x = jax.random.normal(jax.random.key(4), (2, 8, 16))
     params = module.init(jax.random.key(5), x)["params"]
     _, inter = module.apply({"params": params}, x, mutable=["intermediates"])
-    aux = inter["intermediates"]["aux_loss"][0]
+    aux = inter["intermediates"]["aux_loss"]
     assert float(aux) > 0
 
 
@@ -105,7 +105,7 @@ def test_moe_trains():
     def step(params, opt_state):
         def loss_fn(p):
             out, inter = module.apply({"params": p}, x, mutable=["intermediates"])
-            aux = inter["intermediates"]["aux_loss"][0]
+            aux = inter["intermediates"]["aux_loss"]
             return ((out - target) ** 2).mean() + aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
