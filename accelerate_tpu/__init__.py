@@ -43,4 +43,12 @@ from .utils.operations import (
     send_to_device,
 )
 from .utils.precision import DynamicGradScaler, PrecisionPolicy
+from .utils.quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    dequantize_params,
+    load_and_quantize_model,
+    quantize_model,
+    quantize_params,
+)
 from .utils.random import set_seed, synchronize_rng_states

@@ -126,8 +126,14 @@ class PreparedModel:
 
                 def apply_fn(p, *args, extra_state=None, **kwargs):
                     if extra_state is not None:
+                        ins = dict(extra_state)
+                        if "intermediates" in ins:
+                            # write-only collection (flax sow convention): each
+                            # call starts fresh so sown values never leak across
+                            # steps when the state is threaded through
+                            ins["intermediates"] = {}
                         out, mutated = module.apply(
-                            {"params": p, **extra_state},
+                            {"params": p, **ins},
                             *args,
                             mutable=list(extra_state.keys()),
                             **kwargs,
@@ -167,7 +173,9 @@ class PreparedModel:
         if self._hook is not None:
             params, args, kwargs = self._hook.pre_forward(self, params, args, kwargs)
         out, new_state = self._jit_forward(params, self.extra_state, args, kwargs)
-        if new_state is not None:
+        if new_state is not None and self.training:
+            # eval() forwards must be side-effect free: discard state mutations
+            # (fp8 amax rolls, batch_stats updates) outside training mode
             self.extra_state = new_state
         if self._hook is not None:
             out = self._hook.post_forward(self, out)

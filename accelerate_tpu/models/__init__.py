@@ -1,0 +1,15 @@
+"""Model families (the reference's Megatron adapter matrix — Bert/GPT/T5,
+`utils/megatron_lm.py:446-864` — plus Llama/ResNet from the example suite)."""
+
+from .bert import BertConfig, BertForSequenceClassification, bert_sharding_rules
+from .gpt2 import GPT2Config, GPT2LMHead, gpt2_sharding_rules, lm_loss_fn, params_from_hf_gpt2
+from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, llama_sharding_rules, params_from_hf_llama
+from .resnet import ResNet, ResNetConfig, image_classification_loss_fn
+from .t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    params_from_hf_t5,
+    seq2seq_loss_fn,
+    shift_tokens_right,
+    t5_sharding_rules,
+)

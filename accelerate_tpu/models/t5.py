@@ -1,0 +1,344 @@
+"""T5 encoder-decoder family, TPU-first.
+
+Capability position: the reference's Megatron adapter ships per-arch train
+steps for Bert/GPT/**T5** (`utils/megatron_lm.py:446-864`, T5TrainStep at
+`:700`+) — T5 is the encoder-decoder member of its model matrix. This is a
+native flax implementation in the same style as `bert.py`/`llama.py`: bf16
+compute / fp32 masters, fp32 norm + softmax statistics, attention through
+`ops.attention`, TP expressed as sharding rules.
+
+Architecture notes (T5 v1.1): RMS LayerNorm without bias or mean subtraction,
+bucketed relative position bias computed once per stack and shared across
+layers, gated-GELU feed-forward, no positional embeddings, tied or untied LM
+head with the d_model**-0.5 logit rescale when tied.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 768
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 12
+    num_decoder_layers: int = 12
+    num_heads: int = 12
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_eps: float = 1e-6
+    dropout: float = 0.0
+    tie_word_embeddings: bool = False  # v1.1 unties; v1.0 ties
+    gated_ffn: bool = True  # v1.1 gated-GELU; False = v1.0 ReLU
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def base(cls, **kw) -> "T5Config":
+        return cls(**kw)
+
+    @classmethod
+    def small(cls, **kw) -> "T5Config":
+        return cls(**{**dict(d_model=512, d_ff=1024, num_layers=8, num_decoder_layers=8,
+                             num_heads=6), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "T5Config":
+        return cls(**{**dict(vocab_size=512, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+                             num_decoder_layers=2, num_heads=4), **kw})
+
+
+class T5LayerNorm(nn.Module):
+    """RMS norm, no bias, no mean subtraction — statistics in fp32."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), cfg.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + cfg.layer_norm_eps)).astype(cfg.dtype) * scale.astype(cfg.dtype)
+
+
+def relative_position_bucket(
+    relative_position: jax.Array,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """T5's log-bucketed relative positions: half the buckets exact, half log-spaced."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_if_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_if_large = jnp.minimum(val_if_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_if_large)
+
+
+class T5RelativeBias(nn.Module):
+    """Per-stack learned bias table; returns [1, H, Sq, Sk] added to attn logits."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int) -> jax.Array:
+        cfg = self.config
+        table = self.param(
+            "rel_embedding", nn.initializers.normal(0.02),
+            (cfg.relative_attention_num_buckets, cfg.num_heads), cfg.param_dtype,
+        )
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        bucket = relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        bias = table[bucket]  # [Sq, Sk, H]
+        return jnp.transpose(bias, (2, 0, 1))[None].astype(jnp.float32)
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention. T5 uses unscaled dot product (scale folded
+    into init), per-head dim d_kv independent of d_model."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        kv: jax.Array | None = None,
+        bias: jax.Array | None = None,
+        mask: jax.Array | None = None,
+        causal: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        b, s, _ = x.shape
+        kv = x if kv is None else kv
+        inner = cfg.num_heads * cfg.d_kv
+        dense = lambda n, feat: nn.Dense(feat, use_bias=False, dtype=cfg.dtype,
+                                         param_dtype=cfg.param_dtype, name=n)
+        q = dense("q", inner)(x).reshape(b, s, cfg.num_heads, cfg.d_kv)
+        k = dense("k", inner)(kv).reshape(b, kv.shape[1], cfg.num_heads, cfg.d_kv)
+        v = dense("v", inner)(kv).reshape(b, kv.shape[1], cfg.num_heads, cfg.d_kv)
+        out = dot_product_attention(q, k, v, bias=bias, mask=mask, causal=causal, scale=1.0)
+        return dense("o", cfg.d_model)(out.reshape(b, s, inner))
+
+
+class T5FeedForward(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dense = lambda n, feat: nn.Dense(feat, use_bias=False, dtype=cfg.dtype,
+                                         param_dtype=cfg.param_dtype, name=n)
+        if cfg.gated_ffn:
+            h = nn.gelu(dense("wi_0", cfg.d_ff)(x), approximate=True) * dense("wi_1", cfg.d_ff)(x)
+        else:
+            h = nn.relu(dense("wi", cfg.d_ff)(x))
+        return dense("wo", cfg.d_model)(h)
+
+
+class T5Block(nn.Module):
+    config: T5Config
+    is_decoder: bool
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        self_bias: jax.Array | None,
+        enc_out: jax.Array | None = None,
+        self_mask: jax.Array | None = None,
+        cross_mask: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.config
+        # pre-LN everywhere
+        h = T5LayerNorm(cfg, name="ln_self")(x)
+        x = x + T5Attention(cfg, name="self_attn")(
+            h, bias=self_bias, mask=self_mask, causal=self.is_decoder
+        )
+        if self.is_decoder:
+            h = T5LayerNorm(cfg, name="ln_cross")(x)
+            x = x + T5Attention(cfg, name="cross_attn")(h, kv=enc_out, mask=cross_mask)
+        h = T5LayerNorm(cfg, name="ln_ff")(x)
+        return x + T5FeedForward(cfg, name="ff")(h)
+
+
+class T5Stack(nn.Module):
+    config: T5Config
+    is_decoder: bool
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        enc_out: jax.Array | None = None,
+        self_mask: jax.Array | None = None,
+        cross_mask: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.config
+        s = x.shape[1]
+        bias = T5RelativeBias(cfg, bidirectional=not self.is_decoder, name="rel_bias")(s, s)
+        n = cfg.num_decoder_layers if self.is_decoder else cfg.num_layers
+        for i in range(n):
+            x = T5Block(cfg, self.is_decoder, name=f"block_{i}")(
+                x, bias, enc_out, self_mask, cross_mask
+            )
+        return T5LayerNorm(cfg, name="ln_final")(x)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Full encoder-decoder LM; returns fp32 logits [b, tgt, vocab]."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        decoder_input_ids: jax.Array,
+        attention_mask: jax.Array | None = None,
+        decoder_attention_mask: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.config
+        shared = self.param("shared_embedding", nn.initializers.normal(1.0),
+                            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        enc_mask = None if attention_mask is None else attention_mask[:, None, None, :].astype(bool)
+        dec_mask = None if decoder_attention_mask is None else (
+            decoder_attention_mask[:, None, None, :].astype(bool)
+        )
+        cross_mask = enc_mask
+
+        enc_x = shared[input_ids].astype(cfg.dtype)
+        enc_out = T5Stack(cfg, is_decoder=False, name="encoder")(enc_x, self_mask=enc_mask)
+        dec_x = shared[decoder_input_ids].astype(cfg.dtype)
+        dec_out = T5Stack(cfg, is_decoder=True, name="decoder")(
+            dec_x, enc_out=enc_out, self_mask=dec_mask, cross_mask=cross_mask
+        )
+        dec_out = dec_out.astype(jnp.float32)
+        if cfg.tie_word_embeddings:
+            # tied head reuses the embedding; logits rescaled per T5
+            logits = (dec_out * (cfg.d_model ** -0.5)) @ shared.astype(jnp.float32).T
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                              param_dtype=cfg.param_dtype, name="lm_head")(dec_out)
+        return logits
+
+    def init_params(self, rng: jax.Array, batch: int = 2, src: int = 32, tgt: int = 16) -> Any:
+        ids = jnp.zeros((batch, src), dtype=jnp.int32)
+        dec = jnp.zeros((batch, tgt), dtype=jnp.int32)
+        return self.init(rng, ids, dec)["params"]
+
+
+def t5_sharding_rules() -> ShardingRules:
+    """Megatron-style TP: q/k/v/wi column-split, o/wo row-split, embeddings row-split."""
+    return ShardingRules(
+        rules=[
+            (r".*(self_attn|cross_attn)/(q|k|v)/kernel", P(None, "tensor")),
+            (r".*(self_attn|cross_attn)/o/kernel", P("tensor", None)),
+            (r".*ff/(wi|wi_0|wi_1)/kernel", P(None, "tensor")),
+            (r".*ff/wo/kernel", P("tensor", None)),
+            (r".*shared_embedding", P("tensor", None)),
+            (r".*lm_head/kernel", P(None, "tensor")),
+        ]
+    )
+
+
+def seq2seq_loss_fn(model, batch) -> jax.Array:
+    """Padded-token-masked CE over decoder targets. Batch keys: input_ids,
+    decoder_input_ids, labels (pad = -100, the HF convention)."""
+    logits = model(
+        batch["input_ids"],
+        batch["decoder_input_ids"],
+        batch.get("attention_mask"),
+        batch.get("decoder_attention_mask"),
+    )
+    labels = batch["labels"]
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, safe[..., None], axis=-1)[..., 0]
+    return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def shift_tokens_right(labels: jax.Array, decoder_start_token_id: int = 0) -> jax.Array:
+    """Build decoder_input_ids from labels (teacher forcing), replacing -100 with 0."""
+    shifted = jnp.roll(labels, 1, axis=-1).at[:, 0].set(decoder_start_token_id)
+    return jnp.where(shifted == -100, 0, shifted)
+
+
+def params_from_hf_t5(hf_state_dict: dict, config: T5Config) -> dict:
+    """Map HF transformers T5ForConditionalGeneration weights into this layout
+    (torch [out,in] kernels transposed to [in,out])."""
+
+    def _np(t):
+        return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                          dtype=np.float32)
+
+    def _lin(key):
+        return _np(hf_state_dict[key]).T
+
+    params: dict = {"shared_embedding": _np(hf_state_dict["shared.weight"])}
+    if not config.tie_word_embeddings and "lm_head.weight" in hf_state_dict:
+        params["lm_head"] = {"kernel": _lin("lm_head.weight")}
+
+    for side, n_layers, is_dec in (("encoder", config.num_layers, False),
+                                   ("decoder", config.num_decoder_layers, True)):
+        stack: dict = {
+            "rel_bias": {"rel_embedding": _np(
+                hf_state_dict[f"{side}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"]
+            )},
+            "ln_final": {"scale": _np(hf_state_dict[f"{side}.final_layer_norm.weight"])},
+        }
+        for i in range(n_layers):
+            pre = f"{side}.block.{i}.layer"
+            blk: dict = {
+                "ln_self": {"scale": _np(hf_state_dict[f"{pre}.0.layer_norm.weight"])},
+                "self_attn": {w: {"kernel": _lin(f"{pre}.0.SelfAttention.{w}.weight")}
+                              for w in ("q", "k", "v", "o")},
+            }
+            ff_idx = 2 if is_dec else 1
+            if is_dec:
+                blk["ln_cross"] = {"scale": _np(hf_state_dict[f"{pre}.1.layer_norm.weight"])}
+                blk["cross_attn"] = {w: {"kernel": _lin(f"{pre}.1.EncDecAttention.{w}.weight")}
+                                     for w in ("q", "k", "v", "o")}
+            blk["ln_ff"] = {"scale": _np(hf_state_dict[f"{pre}.{ff_idx}.layer_norm.weight"])}
+            ff: dict = {"wo": {"kernel": _lin(f"{pre}.{ff_idx}.DenseReluDense.wo.weight")}}
+            if config.gated_ffn:
+                ff["wi_0"] = {"kernel": _lin(f"{pre}.{ff_idx}.DenseReluDense.wi_0.weight")}
+                ff["wi_1"] = {"kernel": _lin(f"{pre}.{ff_idx}.DenseReluDense.wi_1.weight")}
+            else:
+                ff["wi"] = {"kernel": _lin(f"{pre}.{ff_idx}.DenseReluDense.wi.weight")}
+            blk["ff"] = ff
+            stack[f"block_{i}"] = blk
+        params[side] = stack
+    return params

@@ -11,9 +11,9 @@ the native TPU design. Switch/GShard-style top-k routing with static capacity:
     XLA inserts the token all-to-alls from the shardings.
   - aux load-balancing loss (Switch Transformer) is sown into the
     ``intermediates`` collection; include ``"intermediates": {}`` in the
-    variables passed to ``Accelerator.prepare`` and add
-    ``collect_aux_losses(model.extra_state)`` — or, inside ``loss_fn``,
-    ``collect_aux_losses(m.extra_state)`` — to the loss.
+    variables passed to ``Accelerator.prepare`` and, *inside* ``loss_fn``,
+    add ``collect_aux_losses(m.extra_state)`` to the task loss (it must be
+    inside the differentiated function for the router to receive gradient).
 
 Dropped tokens (over capacity) pass through the residual stream untouched, as in
 GShard/Switch.
@@ -97,10 +97,13 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
         out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), expert_out)
 
-        # Switch aux loss: fraction-routed x mean-prob per expert. Sown with an
-        # overwrite-reduce so the collection keeps a stable pytree structure
-        # across steps (tuple-append sow would grow and force recompiles when
-        # the collection is threaded through the train step as extra_state).
+        # Switch aux loss: fraction-routed x mean-prob per expert. Sown with a
+        # sum-reduce into a single scalar leaf: stable pytree structure across
+        # steps (tuple-append sow would grow and force recompiles when threaded
+        # as extra_state), yet repeated application of one instance (weight
+        # sharing / recurrence) still accumulates every call's contribution —
+        # the incoming collection is emptied per call by the apply wrapper, so
+        # sums never leak across steps.
         me = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
         ce = jnp.mean(probs, axis=0)
         aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
@@ -108,7 +111,7 @@ class MoEMLP(nn.Module):
             "intermediates",
             "aux_loss",
             aux,
-            reduce_fn=lambda prev, new: new,
+            reduce_fn=lambda prev, new: prev + new,
             init_fn=lambda: jnp.zeros((), jnp.float32),
         )
         return out.reshape(b, s, e).astype(x.dtype)

@@ -1,0 +1,291 @@
+"""Weight-only quantization: blockwise int8 and 4-bit (nf4 / fp4).
+
+Capability position: the reference delegates quantization to bitsandbytes —
+`load_and_quantize_model` (`utils/bnb.py:44-195`) swaps `nn.Linear` for CUDA
+`Linear8bitLt`/`Linear4bit` modules (`replace_with_bnb_layers`,
+`utils/bnb.py:274`) driven by a `BnbQuantizationConfig`.
+
+TPU-native design: no layer swap and no custom kernels. Quantization is a
+*pytree transform*: `quantize_params` rewrites eligible weight leaves into
+`QuantizedTensor` pytree nodes (packed integer payload + blockwise fp32
+absmax scales — that is what lives in HBM), and `quantize_model` wraps a
+model's apply_fn so quantized leaves are dequantized to the compute dtype on
+entry. The dequant runs *inside jit*, so XLA fuses the unpack/scale into the
+consuming matmul and the bf16 materialization is transient — the steady-state
+memory is the packed payload, matching bitsandbytes' storage story without
+device-specific kernels.
+
+4-bit uses the NF4 codebook (information-theoretically optimal for normal
+weights, per the QLoRA paper) or the FP4 e2m1 value set; two 4-bit codes are
+packed per uint8. int8 is symmetric absmax per block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# NF4: the 16 quantiles of a standard normal scaled to [-1, 1] (QLoRA).
+NF4_CODE = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    dtype=np.float32,
+)
+
+# FP4 (e2m1): sign x {0, .0625, 8, 12, 4, 6, 2, 3} / 12 — bitsandbytes' value set.
+FP4_CODE = np.array(
+    [
+        0.0, 0.0052, 0.6667, 1.0, 0.3333, 0.5, 0.1667, 0.25,
+        -0.0, -0.0052, -0.6667, -1.0, -0.3333, -0.5, -0.1667, -0.25,
+    ],
+    dtype=np.float32,
+)
+
+
+@dataclass
+class QuantizationConfig:
+    """Mirror of the reference's `BnbQuantizationConfig` (`utils/bnb.py` ctor args).
+
+    load_in_8bit / load_in_4bit pick the payload width; `quant_type` selects the
+    4-bit codebook ("nf4" or "fp4"); `block_size` is the absmax granularity;
+    `skip_modules` / `keep_in_fp32_modules` exclude leaves by substring of their
+    flattened path (the reference's `llm_int8_skip_modules` equivalent).
+    """
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    quant_type: str = "nf4"
+    block_size: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    skip_modules: list = field(default_factory=list)
+    keep_in_fp32_modules: list = field(default_factory=list)
+    min_weight_size: int = 4096  # leaves smaller than this stay unquantized
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("Pick one of load_in_8bit / load_in_4bit, not both")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("One of load_in_8bit / load_in_4bit must be set")
+        if self.quant_type not in ("nf4", "fp4"):
+            raise ValueError(f"quant_type must be nf4 or fp4, got {self.quant_type}")
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """A quantized weight leaf: packed payload + blockwise scales.
+
+    Registered as a pytree node so it flows through jit/device_put/tree maps;
+    `shape`/`bits`/`quant_type`/`compute_dtype` ride in the static aux data.
+    """
+
+    __slots__ = ("data", "scales", "shape", "bits", "quant_type", "compute_dtype")
+
+    def __init__(self, data, scales, shape, bits, quant_type, compute_dtype):
+        self.data = data
+        self.scales = scales
+        self.shape = tuple(shape)
+        self.bits = bits
+        self.quant_type = quant_type
+        self.compute_dtype = compute_dtype
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.shape, self.bits, self.quant_type, self.compute_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.size * self.data.dtype.itemsize + self.scales.size * self.scales.dtype.itemsize)
+
+    @property
+    def dtype(self):
+        return self.compute_dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self) -> str:
+        kind = "int8" if self.bits == 8 else self.quant_type
+        return f"QuantizedTensor({kind}, shape={self.shape}, blocks={self.scales.shape[0]})"
+
+
+def quantize(arr: Any, config: QuantizationConfig) -> QuantizedTensor:
+    """Blockwise-quantize one array on the host (numpy — runs once at load)."""
+    a = np.asarray(jax.device_get(arr), dtype=np.float32)
+    shape = a.shape
+    flat = a.reshape(-1)
+    block = config.block_size
+    pad = (-flat.size) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(-1, block)
+    absmax = np.abs(blocks).max(axis=1)
+    scales = np.where(absmax > 0, absmax, 1.0).astype(np.float32)
+    normed = blocks / scales[:, None]
+
+    if config.bits == 8:
+        q = np.clip(np.round(normed * 127.0), -127, 127).astype(np.int8)
+        payload = q.reshape(-1)
+    else:
+        code = NF4_CODE if config.quant_type == "nf4" else FP4_CODE
+        # nearest-codebook-entry index per element
+        idx = np.abs(normed[..., None] - code[None, None, :]).argmin(axis=-1).astype(np.uint8)
+        idx = idx.reshape(-1)
+        payload = (idx[0::2] << 4) | idx[1::2]  # two nibbles per byte
+
+    return QuantizedTensor(
+        jnp.asarray(payload),
+        jnp.asarray(scales),
+        shape,
+        config.bits,
+        config.quant_type,
+        config.compute_dtype,
+    )
+
+
+def dequantize(qt: QuantizedTensor, dtype: Any | None = None) -> jax.Array:
+    """Rebuild the dense array — jit-friendly, fuses into the consuming matmul."""
+    out_dtype = dtype if dtype is not None else qt.compute_dtype
+    n_blocks = qt.scales.shape[0]
+    if qt.bits == 8:
+        vals = qt.data.astype(jnp.float32).reshape(n_blocks, -1) / 127.0
+    else:
+        hi = (qt.data >> 4).astype(jnp.int32)
+        lo = (qt.data & 0xF).astype(jnp.int32)
+        idx = jnp.stack([hi, lo], axis=-1).reshape(-1)
+        code = jnp.asarray(NF4_CODE if qt.quant_type == "nf4" else FP4_CODE)
+        vals = code[idx].reshape(n_blocks, -1)
+    dense = (vals * qt.scales[:, None]).reshape(-1)
+    size = int(np.prod(qt.shape)) if qt.shape else 1
+    return dense[:size].reshape(qt.shape).astype(out_dtype)
+
+
+def _flat_path(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", getattr(p, "name", getattr(p, "idx", None)))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def quantize_params(params: Any, config: QuantizationConfig) -> Any:
+    """Rewrite eligible weight leaves to QuantizedTensor.
+
+    Eligible = floating, ndim >= 2, size >= min_weight_size, and path not
+    matched by skip_modules / keep_in_fp32_modules (substring match on the
+    flattened "a/b/c" path, like the reference's module-name matching).
+    """
+    skip = list(config.skip_modules) + list(config.keep_in_fp32_modules)
+
+    def _maybe_quantize(path, leaf):
+        if isinstance(leaf, QuantizedTensor):
+            return leaf
+        if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+            return leaf
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        if leaf.size < config.min_weight_size:
+            return leaf
+        name = _flat_path(path)
+        if any(s in name for s in skip):
+            return leaf
+        return quantize(leaf, config)
+
+    return jax.tree_util.tree_map_with_path(_maybe_quantize, params)
+
+
+def dequantize_params(params: Any, dtype: Any | None = None) -> Any:
+    """Inverse transform: QuantizedTensor leaves back to dense arrays."""
+    return jax.tree.map(
+        lambda l: dequantize(l, dtype) if isinstance(l, QuantizedTensor) else l,
+        params,
+        is_leaf=lambda l: isinstance(l, QuantizedTensor),
+    )
+
+
+def quantized_nbytes(params: Any) -> int:
+    """Steady-state HBM footprint of a (possibly partially) quantized tree."""
+    total = 0
+    for leaf in jax.tree.leaves(params, is_leaf=lambda l: isinstance(l, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+def quantize_model(model: Any, config: QuantizationConfig):
+    """Quantize a prepared model's weights in place and patch its apply path.
+
+    The analogue of the reference's layer swap (`replace_with_bnb_layers`): the
+    model's params tree is rewritten and its apply_fn wrapped so quantized
+    leaves are dequantized (inside jit) right before the original forward.
+    Accepts an `accelerator.PreparedModel` or an `(apply_fn, params)` tuple;
+    returns the same kind of object.
+    """
+    from accelerate_tpu.accelerator import PreparedModel
+
+    if isinstance(model, tuple) and len(model) == 2:
+        apply_fn, params = model
+        qparams = quantize_params(params, config)
+
+        def q_apply(p, *args, **kwargs):
+            return apply_fn(dequantize_params(p), *args, **kwargs)
+
+        return q_apply, qparams
+
+    if isinstance(model, PreparedModel):
+        inner = model.apply_fn
+        model.params = quantize_params(model.params, config)
+
+        def q_apply(p, *args, **kwargs):
+            return inner(dequantize_params(p), *args, **kwargs)
+
+        model.apply_fn = q_apply
+        model._jit_forward = None  # drop any forward compiled against dense params
+        return model
+
+    raise TypeError(f"Cannot quantize object of type {type(model)}")
+
+
+def load_and_quantize_model(
+    module: Any,
+    weights_location: str,
+    quantization_config: QuantizationConfig,
+):
+    """Load a safetensors/orbax checkpoint and return quantized (apply_fn, params).
+
+    Mirror of the reference's `load_and_quantize_model` (`utils/bnb.py:44`):
+    weights stream from disk and only the packed payload stays resident.
+    """
+    from accelerate_tpu.checkpointing import load_model_weights
+
+    params = load_model_weights(weights_location)
+    qparams = quantize_params(params, quantization_config)
+    if hasattr(module, "apply"):  # flax module
+        def apply_fn(p, *args, **kwargs):
+            dense = dequantize_params(p)
+            variables = {"params": dense} if "params" not in dense else dense
+            return module.apply(variables, *args, **kwargs)
+    elif callable(module):
+        def apply_fn(p, *args, **kwargs):
+            return module(dequantize_params(p), *args, **kwargs)
+    else:
+        raise TypeError(f"module must be a flax module or callable, got {type(module)}")
+    return apply_fn, qparams

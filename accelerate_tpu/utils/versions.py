@@ -1,0 +1,44 @@
+"""Version comparison gates (reference `utils/versions.py:1-56`)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+
+_OPS = {
+    "<": operator.lt, "<=": operator.le, "==": operator.eq,
+    "!=": operator.ne, ">=": operator.ge, ">": operator.gt,
+}
+
+
+def _parse(v: str) -> tuple:
+    """Numeric components from leading digits, padded, plus a final marker that
+    ranks pre-releases ("0.4.30rc1") below their release ("0.4.30")."""
+    parts = []
+    prerelease = False
+    for p in v.split("."):
+        i = 0
+        while i < len(p) and p[i].isdigit():
+            i += 1
+        parts.append(int(p[:i]) if i else 0)
+        if i < len(p):
+            prerelease = True
+    while len(parts) < 4:
+        parts.append(0)
+    parts.append(0 if prerelease else 1)
+    return tuple(parts)
+
+
+def compare_versions(library_or_version: str, operation: str, requirement_version: str) -> bool:
+    """compare_versions("jax", ">=", "0.4") or compare_versions("0.4.30", "<", "0.5")."""
+    if operation not in _OPS:
+        raise ValueError(f"operation must be one of {sorted(_OPS)}, got {operation}")
+    try:
+        version = importlib.metadata.version(library_or_version)
+    except importlib.metadata.PackageNotFoundError:
+        version = library_or_version  # treat as a literal version string
+    return _OPS[operation](_parse(version), _parse(requirement_version))
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    return compare_versions("jax", operation, version)

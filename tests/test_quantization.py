@@ -1,0 +1,153 @@
+"""Weight-only quantization (reference `utils/bnb.py` capability; tests mirror
+`tests/test_quantization.py` assertions — quantized layers exist, forward still
+works, memory shrinks — without bitsandbytes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    QuantizedTensor,
+    dequantize,
+    dequantize_params,
+    quantize,
+    quantize_model,
+    quantize_params,
+    quantized_nbytes,
+)
+
+
+def _weights(seed=0, shape=(256, 128)):
+    return jnp.asarray(np.random.default_rng(seed).normal(0, 0.02, shape), jnp.float32)
+
+
+class TestRoundTrip:
+    def test_int8_roundtrip_error(self):
+        w = _weights()
+        qt = quantize(w, QuantizationConfig(load_in_8bit=True))
+        back = dequantize(qt, jnp.float32)
+        assert back.shape == w.shape
+        # int8 absmax blockwise: worst-case relative error ~ 1/254 per block
+        err = jnp.abs(back - w).max() / jnp.abs(w).max()
+        assert float(err) < 0.01
+
+    @pytest.mark.parametrize("quant_type", ["nf4", "fp4"])
+    def test_4bit_roundtrip_error(self, quant_type):
+        w = _weights()
+        qt = quantize(w, QuantizationConfig(load_in_4bit=True, quant_type=quant_type))
+        back = dequantize(qt, jnp.float32)
+        assert back.shape == w.shape
+        err = jnp.abs(back - w).max() / jnp.abs(w).max()
+        assert float(err) < 0.2  # 4-bit: coarse but bounded
+
+    def test_nf4_beats_fp4_on_normal_weights(self):
+        w = _weights()
+        nf4 = dequantize(quantize(w, QuantizationConfig(load_in_4bit=True, quant_type="nf4")), jnp.float32)
+        fp4 = dequantize(quantize(w, QuantizationConfig(load_in_4bit=True, quant_type="fp4")), jnp.float32)
+        assert float(jnp.mean((nf4 - w) ** 2)) < float(jnp.mean((fp4 - w) ** 2))
+
+    def test_odd_sizes_pad_correctly(self):
+        w = _weights(shape=(33, 97))  # not a multiple of block_size
+        cfg = QuantizationConfig(load_in_4bit=True, min_weight_size=1)
+        back = dequantize(quantize(w, cfg), jnp.float32)
+        assert back.shape == w.shape
+        assert float(jnp.abs(back - w).max() / jnp.abs(w).max()) < 0.2
+
+
+class TestTreeTransform:
+    def _params(self):
+        return {
+            "dense": {"kernel": _weights(1), "bias": jnp.zeros((128,))},
+            "norm": {"scale": jnp.ones((16,))},
+            "emb": {"table": _weights(2, (512, 64))},
+        }
+
+    def test_quantize_params_selects_matrices_only(self):
+        q = quantize_params(self._params(), QuantizationConfig(load_in_8bit=True))
+        assert isinstance(q["dense"]["kernel"], QuantizedTensor)
+        assert isinstance(q["emb"]["table"], QuantizedTensor)
+        assert not isinstance(q["dense"]["bias"], QuantizedTensor)  # 1-D
+        assert not isinstance(q["norm"]["scale"], QuantizedTensor)
+
+    def test_skip_modules(self):
+        cfg = QuantizationConfig(load_in_8bit=True, skip_modules=["emb"])
+        q = quantize_params(self._params(), cfg)
+        assert not isinstance(q["emb"]["table"], QuantizedTensor)
+        assert isinstance(q["dense"]["kernel"], QuantizedTensor)
+
+    def test_memory_shrinks(self):
+        p = self._params()
+        dense_bytes = sum(l.nbytes for l in jax.tree.leaves(p))
+        q8 = quantize_params(p, QuantizationConfig(load_in_8bit=True))
+        q4 = quantize_params(p, QuantizationConfig(load_in_4bit=True))
+        assert quantized_nbytes(q8) < 0.35 * dense_bytes
+        assert quantized_nbytes(q4) < quantized_nbytes(q8)
+
+    def test_pytree_flows_through_jit(self):
+        q = quantize_params(self._params(), QuantizationConfig(load_in_8bit=True))
+
+        @jax.jit
+        def f(tree):
+            d = dequantize_params(tree, jnp.float32)
+            return d["dense"]["kernel"].sum() + d["emb"]["table"].sum()
+
+        out = f(q)
+        assert jnp.isfinite(out)
+
+    def test_dequantize_params_inverse(self):
+        p = self._params()
+        q = quantize_params(p, QuantizationConfig(load_in_8bit=True))
+        d = dequantize_params(q, jnp.float32)
+        assert jax.tree.structure(d) == jax.tree.structure(p)
+        np.testing.assert_allclose(d["dense"]["bias"], p["dense"]["bias"])
+
+
+class TestQuantizedModelForward:
+    def test_apply_fn_tuple_and_accelerator_prepare(self):
+        from accelerate_tpu.accelerator import Accelerator
+
+        w = {"kernel": _weights(3, (64, 64))}
+
+        def apply_fn(p, x):
+            return x @ p["kernel"]
+
+        x = jnp.ones((4, 64))
+        ref = apply_fn(w, x)
+
+        q_apply, qp = quantize_model((apply_fn, w), QuantizationConfig(load_in_8bit=True))
+        out = q_apply(qp, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.05, atol=0.05)
+
+        acc = Accelerator()
+        model = acc.prepare_model((apply_fn, w))
+        qmodel = quantize_model(model, QuantizationConfig(load_in_8bit=True))
+        out2 = qmodel(x)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref), rtol=0.05, atol=0.05)
+
+    def test_flax_module_path(self):
+        import flax.linen as nn
+
+        from accelerate_tpu.utils.quantization import load_and_quantize_model
+        from accelerate_tpu.checkpointing import save_model_weights
+        import tempfile
+
+        class Mlp(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(128)(x)
+                return nn.Dense(16)(x)
+
+        m = Mlp()
+        variables = m.init(jax.random.key(0), jnp.ones((2, 64)))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64)), jnp.float32)
+        ref = m.apply(variables, x)
+
+        with tempfile.TemporaryDirectory() as d:
+            save_model_weights(variables["params"], d)
+            apply_fn, qp = load_and_quantize_model(
+                m, d, QuantizationConfig(load_in_8bit=True, min_weight_size=1, compute_dtype=jnp.float32)
+            )
+        out = apply_fn(qp, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.1)
