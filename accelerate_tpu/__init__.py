@@ -51,4 +51,16 @@ from .utils.quantization import (
     quantize_model,
     quantize_params,
 )
+from .parallel.compression import CommHookConfig
+from .utils.dataclasses import (
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedDataParallelKwargs,
+    FP8RecipeKwargs,
+    FullyShardedDataParallelPlugin,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MegatronLMPlugin,
+    ProfileKwargs,
+)
 from .utils.random import set_seed, synchronize_rng_states

@@ -644,6 +644,7 @@ class Accelerator:
         optimizer: AcceleratedOptimizer | None = None,
         max_grad_norm: float | None = None,
         donate: bool = True,
+        comm_hook: Any = None,
     ) -> Callable:
         """Build the fused jitted train step — the performance path.
 
@@ -652,6 +653,13 @@ class Accelerator:
         donated jitted update (grads mean + optional global-norm clip + optax
         update + apply). One device program per call; params/opt-state buffers are
         donated so HBM holds a single copy.
+
+        ``comm_hook`` is the reference's DDP comm-hook analogue
+        (`utils/dataclasses.py:117-213`): a `CommHookConfig` (or hook-name string:
+        "fp16"/"bf16"/"power_sgd"/"batched_power_sgd") that compresses the
+        cross-replica gradient reduction. Data-parallel only, like DDP comm hooks.
+        With gradient accumulation the hook reduces every microbatch (DDP-without-
+        no_sync semantics); the common ``k == 1`` path matches DDP exactly.
         """
         if model is None:
             model = self._models[0]
@@ -660,6 +668,29 @@ class Accelerator:
         policy = self.policy
         tx = optimizer.optimizer
         k = self.gradient_state.num_steps
+
+        hook_cfg = None
+        if comm_hook is not None:
+            from .parallel.compression import CommHookConfig, init_comm_state, reduce_gradients
+
+            if hasattr(comm_hook, "to_comm_hook_config"):  # DistributedDataParallelKwargs
+                comm_hook = comm_hook.to_comm_hook_config()
+            hook_cfg = CommHookConfig(comm_hook) if isinstance(comm_hook, str) else comm_hook
+            if hook_cfg is not None and hook_cfg.comm_hook == "no":
+                hook_cfg = None
+        mesh = self.mesh
+        n_replicas = 1
+        if hook_cfg is not None:
+            if mesh is None or mesh.shape.get("data", 1) <= 1:
+                hook_cfg = None  # single replica: nothing to compress
+            else:
+                other = [a for a, s in mesh.shape.items() if a != "data" and s > 1]
+                if other:
+                    raise ValueError(
+                        "comm_hook gradient compression is a data-parallel feature "
+                        f"(like DDP comm hooks); mesh also shards axes {other}."
+                    )
+                n_replicas = mesh.shape["data"]
 
         def loss_and_grads(params, mstate, batch):
             # mstate = mutable non-param collections (batch_stats/fp8_meta/…),
@@ -673,31 +704,95 @@ class Accelerator:
             (loss, new_mstate), grads = jax.value_and_grad(f, has_aux=True)(params)
             return loss, grads, new_mstate
 
-        @jax.jit
-        def micro_step(params, mstate, acc, batch):
+        # lgr signature: (params, mstate, batch, comm_rep, comm_err) ->
+        #                (loss, grads, mstate, comm_rep, comm_err)
+        def lgr_plain(params, mstate, batch, comm_rep, comm_err):
             loss, grads, mstate = loss_and_grads(params, mstate, batch)
-            acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
-            return acc, mstate, loss * k
+            return loss, grads, mstate, comm_rep, comm_err
 
-        def _update(params, opt_state, mstate, acc, batch):
-            loss, grads, mstate = loss_and_grads(params, mstate, batch)
-            if acc is not None:
-                grads = jax.tree.map(jnp.add, acc, grads)
-            if max_grad_norm is not None:
-                grads, _ = _clip_tree(grads, max_grad_norm)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, mstate, loss * k
+        lgr_hooked = None
+        if hook_cfg is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
 
-        update_step = jax.jit(_update, donate_argnums=(0, 1, 2, 3) if donate else ())
-        # separate variant for the common k==1 case (no dead acc argument)
-        state_box = {"acc": None, "count": 0}
+            def _local(params, mstate, batch, comm_rep, comm_err):
+                # per-replica gradients; the only cross-replica traffic is the
+                # compressed reduction + scalar loss pmean. Error-feedback buffers
+                # (comm_err) stay worker-local: leading axis sharded over "data".
+                loss, grads, mstate = loss_and_grads(params, mstate, batch)
+                grads, comm_rep, comm_err = reduce_gradients(
+                    grads, comm_rep, comm_err, "data", hook_cfg
+                )
+                loss = jax.lax.pmean(loss, "data")
+                # mutable collections are computed from the local shard; average
+                # the floating leaves so the declared-replicated output is well
+                # defined (SyncBN-style cross-replica statistics)
+                if mstate is not None:
+                    mstate = jax.tree.map(
+                        lambda x: jax.lax.pmean(x, "data")
+                        if jnp.issubdtype(x.dtype, jnp.floating)
+                        else x,
+                        mstate,
+                    )
+                return loss, grads, mstate, comm_rep, comm_err
+
+            lgr_hooked = shard_map(
+                _local,
+                mesh=mesh,
+                in_specs=(P(), P(), P("data"), P(), P("data")),
+                out_specs=(P(), P(), P(), P(), P("data")),
+                check_vma=False,
+            )
+
+        def make_micro(lgr):
+            @jax.jit
+            def micro_step(params, mstate, acc, batch, comm_rep, comm_err):
+                loss, grads, mstate, comm_rep, comm_err = lgr(
+                    params, mstate, batch, comm_rep, comm_err
+                )
+                acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
+                return acc, mstate, loss * k, comm_rep, comm_err
+
+            return micro_step
+
+        def make_update(lgr):
+            def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err):
+                loss, grads, mstate, comm_rep, comm_err = lgr(
+                    params, mstate, batch, comm_rep, comm_err
+                )
+                if acc is not None:
+                    grads = jax.tree.map(jnp.add, acc, grads)
+                if max_grad_norm is not None:
+                    grads, _ = _clip_tree(grads, max_grad_norm)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, mstate, loss * k, comm_rep, comm_err
+
+            return jax.jit(_update, donate_argnums=(0, 1, 2, 3, 6) if donate else ())
+
+        micro_plain, update_plain = make_micro(lgr_plain), make_update(lgr_plain)
+        micro_hooked = update_hooked = None
+        if hook_cfg is not None:
+            micro_hooked, update_hooked = make_micro(lgr_hooked), make_update(lgr_hooked)
+            comm_rep0, comm_err0 = init_comm_state(model.params, hook_cfg, n_replicas)
+        else:
+            comm_rep0 = comm_err0 = None
+        warmup = hook_cfg.warmup_updates if hook_cfg is not None else 0
+        state_box = {"acc": None, "count": 0, "rep": comm_rep0, "err": comm_err0}
 
         def step(batch: Any) -> jax.Array:
             self._do_sync()
+            hooked = hook_cfg is not None and optimizer._num_updates >= warmup
             if self.gradient_state.sync_gradients:
-                params, opt_state, mstate, loss = update_step(
-                    model.params, optimizer.opt_state, model.extra_state, state_box["acc"], batch
+                upd = update_hooked if hooked else update_plain
+                params, opt_state, mstate, loss, state_box["rep"], state_box["err"] = upd(
+                    model.params,
+                    optimizer.opt_state,
+                    model.extra_state,
+                    state_box["acc"],
+                    batch,
+                    state_box["rep"],
+                    state_box["err"],
                 )
                 model.params = params
                 optimizer.opt_state = opt_state
@@ -706,8 +801,16 @@ class Accelerator:
                 state_box["acc"] = None
                 state_box["count"] = 0
             else:
-                state_box["acc"], model.extra_state, loss = micro_step(
-                    model.params, model.extra_state, state_box["acc"], batch
+                micro = micro_hooked if hooked else micro_plain
+                state_box["acc"], model.extra_state, loss, state_box["rep"], state_box["err"] = (
+                    micro(
+                        model.params,
+                        model.extra_state,
+                        state_box["acc"],
+                        batch,
+                        state_box["rep"],
+                        state_box["err"],
+                    )
                 )
                 state_box["count"] += 1
             return loss

@@ -165,3 +165,32 @@ class InitProcessGroupKwargs(KwargsHandler):
     jax.distributed.initialize timeouts."""
 
     timeout_seconds: int = 1800
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Data-parallel knobs (reference `DistributedDataParallelKwargs`,
+    `dataclasses.py:117-213`). Bucketing/broadcast knobs have no analogue — XLA
+    schedules gradient reductions itself; what carries over is the comm-hook
+    family (fp16/bf16/PowerSGD gradient compression, see
+    `parallel/compression.py`), exposed here as `comm_hook` + state options and
+    consumed by `Accelerator.make_train_step(comm_hook=...)`.
+    """
+
+    bucket_cap_mb: int = 25  # accepted for parity; XLA ignores it
+    find_unused_parameters: bool = False  # meaningless under whole-graph autodiff
+    static_graph: bool = False  # jit is always a static graph
+    comm_hook: str = "no"  # no | fp16 | bf16 | power_sgd | batched_power_sgd
+    matrix_approximation_rank: int = 1
+    start_powerSGD_iter: int = 2
+
+    def to_comm_hook_config(self):
+        from ..parallel.compression import CommHookConfig
+
+        if self.comm_hook == "no":
+            return None
+        return CommHookConfig(
+            comm_hook=self.comm_hook,
+            matrix_approximation_rank=self.matrix_approximation_rank,
+            start_powerSGD_iter=self.start_powerSGD_iter,
+        )

@@ -252,7 +252,24 @@ def quantize_model(model: Any, config: QuantizationConfig):
 
     if isinstance(model, PreparedModel):
         inner = model.apply_fn
-        model.params = quantize_params(model.params, config)
+        qparams = quantize_params(model.params, config)
+        if getattr(model, "shardings", None) is not None:
+            # re-place on the mesh: quantization round-trips through the host, so
+            # without this every leaf would land unsharded on the default device.
+            # Dense (skipped) leaves keep their original sharding; packed leaves
+            # have different shapes than their spec described, so they replicate
+            # (the payload is 4-8x smaller than the dense bf16 weight).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(q, s):
+                if isinstance(q, QuantizedTensor):
+                    return jax.device_put(q, NamedSharding(s.mesh, PartitionSpec()))
+                return jax.device_put(q, s)
+
+            qparams = jax.tree.map(
+                place, qparams, model.shardings, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+            )
+        model.params = qparams
 
         def q_apply(p, *args, **kwargs):
             return inner(dequantize_params(p), *args, **kwargs)

@@ -13,7 +13,10 @@ _OPS = {
 
 def _parse(v: str) -> tuple:
     """Numeric components from leading digits, padded, plus a final marker that
-    ranks pre-releases ("0.4.30rc1") below their release ("0.4.30")."""
+    ranks pre-releases ("0.4.30rc1") below their release ("0.4.30"). A PEP 440
+    local segment ("2.1.0+cu118") is dropped before parsing — local builds
+    satisfy the same bounds as their public release, they are not pre-releases."""
+    v = v.split("+", 1)[0]
     parts = []
     prerelease = False
     for p in v.split("."):

@@ -1,0 +1,26 @@
+"""Version-gate parsing (reference `utils/versions.py`): pre-releases rank below
+their release, PEP 440 local builds rank with it."""
+
+from accelerate_tpu.utils.versions import compare_versions
+
+
+def test_release_ordering():
+    assert compare_versions("0.4.30", ">=", "0.4")
+    assert compare_versions("0.4.30", "<", "0.5")
+    assert compare_versions("2.1.0", "==", "2.1.0")
+
+
+def test_prerelease_below_release():
+    assert compare_versions("0.4.30rc1", "<", "0.4.30")
+    assert not compare_versions("0.4.30rc1", ">=", "0.4.30")
+
+
+def test_local_build_satisfies_release_bounds():
+    # '2.1.0+cu118' is not a pre-release: it satisfies >=2.1.0 and ==2.1.0
+    assert compare_versions("2.1.0+cu118", ">=", "2.1.0")
+    assert compare_versions("2.1.0+cu118", "==", "2.1.0")
+    assert not compare_versions("2.1.0+cu118", "<", "2.1.0")
+
+
+def test_installed_package_lookup():
+    assert compare_versions("jax", ">=", "0.1")
