@@ -1,0 +1,82 @@
+"""Shared tiny workload for the by_feature examples: a 2-class MLP on separable
+synthetic features. Kept deliberately small so every feature script runs in
+seconds on CPU; swap in a real model/dataset for production use.
+
+(The reference's by_feature scripts each carry a BERT/MRPC setup inline; here the
+setup lives in one module so each script shows only the feature it demonstrates.)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+FEATURES = 16
+CLASSES = 2
+
+
+def make_batches(n_batches: int, batch_size: int, seed: int = 0):
+    """Separable 2-class problem: class 1 has a positive mean shift."""
+    rng = np.random.default_rng(seed)
+    n = n_batches * batch_size
+    labels = rng.integers(0, CLASSES, size=(n,)).astype(np.int32)
+    x = rng.normal(size=(n, FEATURES)).astype(np.float32) + labels[:, None] * 1.5
+    return [
+        {"x": x[i * batch_size : (i + 1) * batch_size],
+         "labels": labels[i * batch_size : (i + 1) * batch_size]}
+        for i in range(n_batches)
+    ]
+
+
+def init_params(seed: int = 0, hidden: int = 32):
+    rng = np.random.default_rng(seed)
+    return {
+        "w1": (rng.normal(size=(FEATURES, hidden)) * 0.1).astype(np.float32),
+        "b1": np.zeros((hidden,), np.float32),
+        "w2": (rng.normal(size=(hidden, CLASSES)) * 0.1).astype(np.float32),
+        "b2": np.zeros((CLASSES,), np.float32),
+    }
+
+
+def apply_fn(params, x):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(model, batch):
+    import jax.numpy as jnp
+    import optax
+
+    logits = model(batch["x"])
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["labels"]
+    ).mean()
+
+
+def evaluate(accelerator, model, eval_batches):
+    """Distributed eval with duplicate-tail-safe gathering."""
+    import jax.numpy as jnp
+
+    correct = total = 0
+    for batch in eval_batches:
+        preds = jnp.argmax(model(batch["x"]), axis=-1)
+        g = accelerator.gather_for_metrics({"preds": preds, "labels": batch["labels"]})
+        correct += int((np.asarray(g["preds"]) == np.asarray(g["labels"])).sum())
+        total += len(np.asarray(g["labels"]))
+    return correct / max(total, 1)
+
+
+def base_parser(**extra_defaults) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=extra_defaults.get("lr", 1e-2))
+    parser.add_argument("--num_epochs", type=int, default=extra_defaults.get("num_epochs", 2))
+    parser.add_argument("--batch_size", type=int, default=extra_defaults.get("batch_size", 32))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--tiny", action="store_true", help="smoke-test sizes")
+    return parser

@@ -1,0 +1,53 @@
+"""Feature: automatic gradient accumulation (reference
+`by_feature/automatic_gradient_accumulation.py`).
+
+Combines `find_executable_batch_size` with accumulation: when the per-device
+batch must shrink to fit memory, the accumulation step count grows to keep the
+OBSERVED (effective) batch size constant.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, find_executable_batch_size, set_seed
+
+OBSERVED_BATCH_SIZE = 256  # the effective batch the optimizer should see
+
+
+def main() -> None:
+    args = base_parser().parse_args()
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+
+    @find_executable_batch_size(starting_batch_size=OBSERVED_BATCH_SIZE)
+    def inner_training_loop(batch_size):
+        accum = OBSERVED_BATCH_SIZE // batch_size
+        accelerator.print(f"batch_size={batch_size} x accumulation={accum}")
+        accelerator.free_memory()
+        accelerator.gradient_accumulation_steps = accum
+        n_train = 2 * accum if args.tiny else 8 * accum
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            (apply_fn, init_params(args.seed)),
+            optax.adam(args.lr),
+            DataLoaderShard(make_batches(n_train, batch_size)),
+            DataLoaderShard(make_batches(4, batch_size, seed=1)),
+        )
+        step = accelerator.make_train_step(loss_fn)
+        for _ in range(args.num_epochs):
+            for batch in train_dl:
+                loss = step(batch)
+        return evaluate(accelerator, model, eval_dl), float(loss)
+
+    acc, loss = inner_training_loop()
+    accelerator.print(f"loss={loss:.4f} accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

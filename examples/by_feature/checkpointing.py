@@ -1,0 +1,72 @@
+"""Feature: checkpoint/resume (reference `by_feature/checkpointing.py`).
+
+`save_state` captures model/optimizer/scheduler/RNG/step into a rotating
+`checkpoints/checkpoint_<i>` directory; `load_state` restores it and
+`skip_first_batches` resumes mid-epoch (reference `accelerator.py:2953-3255`,
+`data_loader.py:1245`).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed, skip_first_batches
+from accelerate_tpu.accelerator import ProjectConfiguration
+
+
+def main() -> None:
+    parser = base_parser()
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    args = parser.parse_args()
+    set_seed(args.seed)
+    project_dir = args.project_dir or tempfile.mkdtemp(prefix="ckpt_example_")
+
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        project_config=ProjectConfiguration(
+            project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=2
+        ),
+    )
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(4, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+
+    for epoch in range(args.num_epochs):
+        dl = train_dl
+        if args.resume_from_checkpoint and epoch == 0:
+            dl = skip_first_batches(train_dl, 2)  # demo: resume past 2 batches
+        for batch in dl:
+            loss = step(batch)
+        accelerator.save_state()  # checkpoints/checkpoint_<epoch>, rotated at 2
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+
+    # round-trip proof: clobber params, restore, same metric
+    before = evaluate(accelerator, model, eval_dl)
+    model.load_state_dict(
+        {k: np.zeros_like(np.asarray(v)) for k, v in model.state_dict().items()}
+    )
+    accelerator.load_state()  # latest checkpoint
+    after = evaluate(accelerator, model, eval_dl)
+    accelerator.print(f"restore parity: accuracy {before:.3f} == {after:.3f}")
+    assert abs(before - after) < 1e-6
+
+
+if __name__ == "__main__":
+    main()

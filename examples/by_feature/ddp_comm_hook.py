@@ -1,0 +1,54 @@
+"""Feature: gradient compression hooks (reference `by_feature/ddp_comm_hook.py`).
+
+`make_train_step(comm_hook=...)` compresses the cross-replica gradient
+reduction: "bf16"/"fp16" cast the all-reduce payload, "power_sgd" sends a rank-r
+factorization with per-replica error feedback (reference DDP comm hooks,
+`utils/dataclasses.py:117-213`).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, DistributedDataParallelKwargs, set_seed
+
+
+def main() -> None:
+    parser = base_parser()
+    parser.add_argument(
+        "--ddp_comm_hook",
+        default="bf16",
+        choices=["no", "fp16", "bf16", "power_sgd", "batched_power_sgd"],
+    )
+    args = parser.parse_args()
+    set_seed(args.seed)
+
+    ddp_kwargs = DistributedDataParallelKwargs(
+        comm_hook=args.ddp_comm_hook, matrix_approximation_rank=2
+    )
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(4, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn, comm_hook=ddp_kwargs)
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.print(
+            f"epoch {epoch} [{args.ddp_comm_hook}]: loss={float(loss):.4f} accuracy={acc:.3f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

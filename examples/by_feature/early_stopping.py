@@ -1,0 +1,57 @@
+"""Feature: coordinated early stopping (reference `by_feature/early_stopping.py`).
+
+Any process may call `set_trigger()`; `check_trigger()` all-reduces the flag so
+every process sees it and breaks the loop together — the breakpoint mechanism of
+reference `accelerator.py:2233-2290`.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed
+
+
+def main() -> None:
+    parser = base_parser(num_epochs=10)
+    parser.add_argument("--early_stop_loss", type=float, default=0.2)
+    args = parser.parse_args()
+    set_seed(args.seed)
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(4, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+
+    stopped_at = None
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+            # local decision (e.g. main process watching validation loss) ...
+            if float(loss) < args.early_stop_loss:
+                accelerator.set_trigger()
+            # ... made global: every process agrees to break on the same step
+            if accelerator.check_trigger():
+                stopped_at = epoch
+                break
+        if stopped_at is not None:
+            break
+    acc = evaluate(accelerator, model, eval_dl)
+    accelerator.print(
+        f"stopped at epoch {stopped_at}: loss={float(loss):.4f} accuracy={acc:.3f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

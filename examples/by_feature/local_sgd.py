@@ -1,0 +1,61 @@
+"""Feature: Local SGD (reference `by_feature/local_sgd.py`).
+
+Each data-parallel replica runs its own optimizer with zero cross-replica
+traffic; every `local_sgd_steps` steps the parameter islands are averaged with
+one pmean (reference `local_sgd.py` — no_sync + periodic `reduce(mean)`).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, set_seed
+from accelerate_tpu.local_sgd import LocalSGD, make_local_train_step
+
+
+def main() -> None:
+    parser = base_parser()
+    parser.add_argument("--local_sgd_steps", type=int, default=4)
+    args = parser.parse_args()
+    set_seed(args.seed)
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    tx = optax.adam(args.lr)
+    local_step, sync, replicate, unreplicate = make_local_train_step(
+        loss_fn, apply_fn, tx, accelerator.mesh
+    )
+    island = replicate(init_params(args.seed))
+
+    from accelerate_tpu import DataLoaderShard
+
+    n_train = 4 if args.tiny else 16
+    train_dl = accelerator.prepare_data_loader(
+        DataLoaderShard(make_batches(n_train, args.batch_size))
+    )
+    with LocalSGD(sync_fn=sync, local_sgd_steps=args.local_sgd_steps) as lsgd:
+        for _ in range(args.num_epochs):
+            for batch in train_dl:
+                island, loss = local_step(island, batch)
+                island = lsgd.step(island)  # pmean every local_sgd_steps
+    island = sync(island)  # final average
+
+    params = unreplicate(island)
+    import jax.numpy as jnp
+    import numpy as np
+
+    correct = total = 0
+    for batch in make_batches(4, args.batch_size, seed=1):
+        preds = jnp.argmax(apply_fn(params, jnp.asarray(batch["x"])), axis=-1)
+        correct += int((np.asarray(preds) == batch["labels"]).sum())
+        total += len(batch["labels"])
+    accelerator.print(f"loss={float(loss.mean()):.4f} accuracy={correct / total:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Feature: OOM-adaptive batch size (reference `by_feature/memory.py`).
+
+`find_executable_batch_size` calls the training function with a starting batch
+size and, on device-memory exhaustion (XLA RESOURCE_EXHAUSTED), halves it and
+retries — each retry recompiles at the new static shape (reference
+`utils/memory.py:111-168`).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, find_executable_batch_size, set_seed
+
+
+def main() -> None:
+    parser = base_parser()
+    parser.add_argument("--starting_batch_size", type=int, default=256)
+    args = parser.parse_args()
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def inner_training_loop(batch_size):
+        accelerator.print(f"trying batch_size={batch_size}")
+        accelerator.free_memory()  # reset prepared objects between attempts
+        n_train = 4 if args.tiny else 12
+        model, optimizer, train_dl, eval_dl = accelerator.prepare(
+            (apply_fn, init_params(args.seed)),
+            optax.adam(args.lr),
+            DataLoaderShard(make_batches(n_train, batch_size)),
+            DataLoaderShard(make_batches(4, batch_size, seed=1)),
+        )
+        step = accelerator.make_train_step(loss_fn)
+        for _ in range(args.num_epochs):
+            for batch in train_dl:
+                loss = step(batch)
+        return evaluate(accelerator, model, eval_dl), float(loss)
+
+    acc, loss = inner_training_loop()
+    accelerator.print(
+        f"converged at batch_size={inner_training_loop.batch_size}: "
+        f"loss={loss:.4f} accuracy={acc:.3f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

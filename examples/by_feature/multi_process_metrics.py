@@ -1,0 +1,57 @@
+"""Feature: multi-process metrics (reference `by_feature/multi_process_metrics.py`).
+
+`gather_for_metrics` collects per-shard eval outputs across the mesh and drops
+the duplicated tail of the final ragged batch, so metrics match a single-process
+run exactly (reference `accelerator.py:2443-2505`).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed
+
+
+def main() -> None:
+    args = base_parser().parse_args()
+    set_seed(args.seed)
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(5, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+    for _ in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+
+    # accumulate predictions the metrics-library way: all processes end up with
+    # the full, deduplicated eval set
+    all_preds, all_labels = [], []
+    for batch in eval_dl:
+        preds = jnp.argmax(model(batch["x"]), axis=-1)
+        g = accelerator.gather_for_metrics({"preds": preds, "labels": batch["labels"]})
+        all_preds.append(np.asarray(g["preds"]))
+        all_labels.append(np.asarray(g["labels"]))
+    preds = np.concatenate(all_preds)
+    labels = np.concatenate(all_labels)
+    accelerator.print(
+        f"eval set {len(labels)} samples, loss={float(loss):.4f} "
+        f"accuracy={float((preds == labels).mean()):.3f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

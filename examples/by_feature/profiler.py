@@ -1,0 +1,52 @@
+"""Feature: profiling (reference `by_feature/profiler.py`).
+
+`accelerator.profile()` wraps `jax.profiler` tracing — one trace directory per
+host, viewable in TensorBoard/Perfetto (reference wraps `torch.profiler.profile`
+and exports Chrome traces, `accelerator.py:3449-3506`).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed
+
+
+def main() -> None:
+    args = base_parser(num_epochs=1).parse_args()
+    set_seed(args.seed)
+    trace_dir = args.project_dir or tempfile.mkdtemp(prefix="profile_traces_")
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    n_train = 4 if args.tiny else 8
+    model, optimizer, train_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+
+    # warm up outside the trace so compile time doesn't dominate the profile
+    for batch in train_dl:
+        loss = step(batch)
+
+    with accelerator.profile(log_dir=trace_dir):
+        for batch in train_dl:
+            loss = step(batch)
+
+    traces = list(Path(trace_dir).rglob("*"))
+    accelerator.print(
+        f"loss={float(loss):.4f}; wrote {sum(1 for t in traces if t.is_file())} "
+        f"trace files under {trace_dir} (accuracy of profiling: view in TensorBoard)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Feature: schedule-free training (reference `by_feature/schedule_free.py`).
+
+The reference uses `schedulefree.AdamWScheduleFree`; the optax-native equivalent
+is `optax.contrib.schedule_free` wrapping any base optimizer — no LR schedule
+object, and evaluation should use the schedule-free "eval params".
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed
+
+
+def main() -> None:
+    args = base_parser(lr=2e-2).parse_args()
+    set_seed(args.seed)
+
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+    tx = optax.contrib.schedule_free_adamw(learning_rate=args.lr, warmup_steps=2)
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        tx,
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(4, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+        # schedule-free keeps train params (z) and eval params (x) distinct:
+        # evaluate at the interpolated eval point
+        import optax.contrib as contrib
+
+        eval_params = contrib.schedule_free_eval_params(
+            optimizer.opt_state, model.params
+        )
+        train_params = model.params
+        model.load_state_dict(eval_params)
+        acc = evaluate(accelerator, model, eval_dl)
+        model.load_state_dict(train_params)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Feature: experiment tracking (reference `by_feature/tracking.py`).
+
+`init_trackers` starts every configured tracker (TensorBoard/WandB/MLflow/...;
+"jsonl" is the dependency-free built-in), `log` records rank-0 metrics, and
+`end_training` flushes (reference `tracking.py` + `accelerator.py:2645-2772`).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+import optax
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _common import apply_fn, base_parser, evaluate, init_params, loss_fn, make_batches
+
+from accelerate_tpu import Accelerator, DataLoaderShard, set_seed
+
+
+def main() -> None:
+    parser = base_parser()
+    parser.add_argument("--log_with", default="jsonl", help="jsonl|tensorboard|wandb|...")
+    args = parser.parse_args()
+    set_seed(args.seed)
+    project_dir = args.project_dir or tempfile.mkdtemp(prefix="tracking_example_")
+
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision, log_with=args.log_with, project_dir=project_dir
+    )
+    accelerator.init_trackers("tracking_example", config=vars(args))
+
+    n_train = 4 if args.tiny else 12
+    model, optimizer, train_dl, eval_dl = accelerator.prepare(
+        (apply_fn, init_params(args.seed)),
+        optax.adam(args.lr),
+        DataLoaderShard(make_batches(n_train, args.batch_size)),
+        DataLoaderShard(make_batches(4, args.batch_size, seed=1)),
+    )
+    step = accelerator.make_train_step(loss_fn)
+    global_step = 0
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+            accelerator.log({"train_loss": float(loss)}, step=global_step)
+            global_step += 1
+        acc = evaluate(accelerator, model, eval_dl)
+        accelerator.log({"accuracy": acc, "epoch": epoch}, step=global_step)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+    accelerator.end_training()
+    accelerator.print(f"metrics logged under {project_dir}")
+
+
+if __name__ == "__main__":
+    main()
