@@ -22,6 +22,7 @@ from .data_loader import (
     prepare_data_loader,
     skip_first_batches,
 )
+from .inference import prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
 from .logging import get_logger
 from .memory import find_executable_batch_size, release_memory
