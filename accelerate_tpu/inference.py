@@ -17,14 +17,14 @@ finishes with the full logits.
 
 from __future__ import annotations
 
-import math
 from typing import Any, Callable, Sequence
 
 import jax
-import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .big_modeling import BlockwiseModel
-from .parallel.pipeline import pipeline_apply, stack_stage_params
+from .parallel.pipeline import pipeline_apply
 from .state import PartialState
 
 
@@ -45,6 +45,12 @@ def _trunk_split(names: Sequence[str], num_stages: int, split_points) -> list[li
             )
         per = n // num_stages
         return [list(names[i * per : (i + 1) * per]) for i in range(num_stages)]
+    unknown = [p for p in split_points if p not in names]
+    if unknown:
+        raise ValueError(
+            f"split_points {unknown} are not trunk blocks; valid split points "
+            f"are {list(names)} (the prologue/epilogue cannot start a stage)."
+        )
     bounds = [0] + [names.index(p) for p in split_points] + [n]
     groups = [list(names[a:b]) for a, b in zip(bounds[:-1], bounds[1:])]
     sizes = {len(g) for g in groups}
@@ -96,11 +102,18 @@ def prepare_pippy(
     per_stage = len(groups[0])
     block_fn = fns[trunk[0]]  # trunk blocks are uniform: one program, many params
 
-    # params: stack trunk blocks -> (S*per, ...) -> regroup (S, per, ...)
-    stacked = stack_stage_params([state_dict[n] for g in groups for n in g])
-    stage_params = jax.tree.map(
-        lambda p: p.reshape(num_stages, per_stage, *p.shape[1:]), stacked
-    )
+    # params: stack trunk blocks on host -> (S, per, ...) -> place sharded over
+    # the stage axis directly, so no single device ever holds the whole trunk
+    # (each stage's slice streams to its own devices)
+    trunk_trees = [state_dict[n] for g in groups for n in g]
+    stage_sharding = NamedSharding(mesh, P(axis_name))
+
+    def _stack_and_place(*leaves):
+        host = np.stack([np.asarray(l) for l in leaves])
+        host = host.reshape(num_stages, per_stage, *host.shape[1:])
+        return jax.device_put(host, stage_sharding)
+
+    stage_params = jax.tree.map(_stack_and_place, *trunk_trees)
     prologue_params = state_dict[prologue_name]
     epilogue_params = state_dict[epilogue_name]
 
@@ -122,6 +135,12 @@ def prepare_pippy(
     jitted = jax.jit(forward)
 
     def pp_forward(x, *args, **kwargs):
+        if args or kwargs:
+            raise TypeError(
+                "pp_forward takes a single input array; extra forward arguments "
+                f"are not threaded through the pipeline (got {len(args)} args, "
+                f"{sorted(kwargs)} kwargs). Bake them into the block fns instead."
+            )
         return jitted(prologue_params, stage_params, epilogue_params, x)
 
     pp_forward.num_stages = num_stages

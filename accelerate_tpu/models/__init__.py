@@ -4,7 +4,21 @@
 from .bert import BertConfig, BertForSequenceClassification, bert_sharding_rules
 from .gpt2 import GPT2Config, GPT2LMHead, gpt2_sharding_rules, lm_loss_fn, params_from_hf_gpt2
 from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, llama_sharding_rules, params_from_hf_llama
+from .mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    mixtral_loss_fn,
+    mixtral_sharding_rules,
+    params_from_hf_mixtral,
+)
 from .resnet import ResNet, ResNetConfig, image_classification_loss_fn
+from .vit import (
+    ViTConfig,
+    ViTForImageClassification,
+    params_from_hf_vit,
+    vit_loss_fn,
+    vit_sharding_rules,
+)
 from .t5 import (
     T5Config,
     T5ForConditionalGeneration,

@@ -1,0 +1,248 @@
+"""Mixtral: Llama backbone with a sparse top-k mixture-of-experts FFN.
+
+Capability position: the reference has no MoE model support at all — its only
+MoE surface is marking expert classes as DeepSpeed ZeRO-3 leaves
+(`utils/dataclasses.py:1352-1370`; SURVEY.md §2.4 EP row "not implemented").
+This is the TPU-native design: GShard/Switch-style static-capacity dispatch as
+one-hot einsums (MXU-friendly, no gather/scatter), expert-stacked weights whose
+leading dim shards over the ``tensor`` mesh axis (expert parallelism), and XLA
+deriving the token all-to-alls from the shardings.
+
+Routing follows HF Mixtral semantics: softmax over the selected top-k logits
+(not over all experts), SwiGLU experts (w1 gate, w3 up, w2 down). Tokens beyond
+an expert's capacity fall through on the residual stream (GShard behavior; set
+``capacity_factor >= num_experts / top_k`` for drop-free routing, e.g. in parity
+tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import ShardingRules
+from .llama import LlamaAttention, LlamaConfig, RMSNorm
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 4096
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_weight: float = 0.001  # HF MixtralConfig.router_aux_loss_coef default
+    rope_theta: float = 1e6
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attention_impl: str = "auto"
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "MixtralConfig":
+        return cls(**{**dict(vocab_size=256, max_position_embeddings=128, hidden_size=64,
+                             intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+                             num_experts=4, top_k=2), **kw})
+
+    def as_llama(self) -> LlamaConfig:
+        """Attention/backbone hyperparams reused from the Llama implementation."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            max_position_embeddings=self.max_position_embeddings,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads,
+            rope_theta=self.rope_theta,
+            rms_norm_eps=self.rms_norm_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            remat=self.remat,
+            attention_impl=self.attention_impl,
+        )
+
+
+class MixtralSparseMoeBlock(nn.Module):
+    """Top-k routed SwiGLU experts with static-capacity einsum dispatch."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        n_tokens = b * s
+        E, k = cfg.num_experts, cfg.top_k
+        capacity = max(int(cfg.capacity_factor * n_tokens * k / E), 1)
+
+        xt = x.reshape(n_tokens, e)
+        router_logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                                 param_dtype=cfg.param_dtype, name="gate")(
+            xt.astype(jnp.float32)
+        )
+        # HF Mixtral: softmax over the SELECTED top-k logits
+        top_logits, expert_idx = jax.lax.top_k(router_logits, k)  # [T, k]
+        gate_vals = jax.nn.softmax(top_logits, axis=-1)  # [T, k]
+
+        from ..ops.moe import build_dispatch_combine, sow_aux_loss
+
+        dispatch, combine = build_dispatch_combine(
+            expert_idx, gate_vals, E, capacity, cfg.dtype
+        )
+
+        # expert-stacked SwiGLU weights; leading (expert) dim shards over tensor
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, e, cfg.intermediate_size), cfg.param_dtype)
+        w3 = self.param("w3", nn.initializers.lecun_normal(),
+                        (E, e, cfg.intermediate_size), cfg.param_dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, cfg.intermediate_size, e), cfg.param_dtype)
+
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xt.astype(cfg.dtype))
+        gate_h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(cfg.dtype))
+        up_h = jnp.einsum("ecd,edf->ecf", expert_in, w3.astype(cfg.dtype))
+        h = jax.nn.silu(gate_h) * up_h
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w2.astype(cfg.dtype))
+        out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), expert_out)
+
+        # HF load-balancing aux loss: fraction of tokens per expert counted over
+        # ALL top-k selections x mean full-softmax prob per expert
+        all_sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, k, E]
+        me = jnp.mean(jnp.sum(all_sel, axis=1) / k, axis=0)  # [E]
+        ce = jnp.mean(jax.nn.softmax(router_logits, axis=-1), axis=0)
+        aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
+        sow_aux_loss(self, aux)
+        return out.reshape(b, s, e).astype(x.dtype)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, decode: bool = False, position_offset: Any = 0) -> jax.Array:
+        cfg = self.config
+        lcfg = cfg.as_llama()
+        x = x + LlamaAttention(lcfg, name="attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="input_norm")(x),
+            decode, position_offset,
+        )
+        x = x + MixtralSparseMoeBlock(cfg, name="moe")(
+            RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="post_attn_norm")(x)
+        )
+        return x
+
+
+class MixtralForCausalLM(nn.Module):
+    """Returns fp32 logits [batch, seq, vocab]."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        deterministic: bool = True,
+        decode: bool = False,
+        position_offset: Any = 0,
+    ) -> jax.Array:
+        cfg = self.config
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed.astype(cfg.dtype)[input_ids]
+        block = nn.remat(MixtralBlock, prevent_cse=False) if cfg.remat else MixtralBlock
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        lm_head = self.param("lm_head", nn.initializers.normal(0.02),
+                             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        return jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), lm_head.astype(cfg.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def init_params(self, rng: jax.Array, batch: int = 2, seq: int = 16) -> Any:
+        return self.init(rng, jnp.zeros((batch, seq), dtype=jnp.int32))["params"]
+
+
+def mixtral_sharding_rules() -> ShardingRules:
+    """TP on attention + EP on experts: q/k/v column-parallel, o row-parallel,
+    expert-stacked w1/w2/w3 shard their leading (expert) dim over ``tensor``,
+    the router stays replicated (reference has no equivalent; SURVEY.md §2.4)."""
+    return ShardingRules(
+        rules=[
+            (r".*attn/(q_proj|k_proj|v_proj)/kernel", P(None, "tensor")),
+            (r".*attn/o_proj/kernel", P("tensor", None)),
+            (r".*moe/(w1|w2|w3)", P("tensor", None, None)),
+            (r".*moe/gate.*", P(None, None)),
+            (r".*embed_tokens", P("tensor", None)),
+            (r".*lm_head", P("tensor", None)),
+        ]
+    )
+
+
+def mixtral_loss_fn(model, batch) -> jax.Array:
+    """LM loss + sown router aux losses (must be added inside the grad fn)."""
+    from ..ops.moe import collect_aux_losses
+    from .gpt2 import cross_entropy_loss
+
+    logits = model(batch["input_ids"])
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    return cross_entropy_loss(logits, labels) + collect_aux_losses(model.extra_state)
+
+
+def params_from_hf_mixtral(hf_state_dict: dict, config: MixtralConfig) -> dict:
+    """Map HF transformers MixtralForCausalLM weights into this layout (torch
+    Linear stores [out, in] -> transpose; per-expert w1/w2/w3 stack on a leading
+    expert dim)."""
+
+    def _np(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    def _lin(key):
+        return _np(hf_state_dict[key]).T
+
+    p: dict[str, Any] = {
+        "embed_tokens": _np(hf_state_dict["model.embed_tokens.weight"]),
+        "final_norm": {"scale": _np(hf_state_dict["model.norm.weight"])},
+        "lm_head": _np(hf_state_dict["lm_head.weight"]),
+    }
+    for i in range(config.num_layers):
+        hf = f"model.layers.{i}."
+        moe = hf + "block_sparse_moe."
+        p[f"layer_{i}"] = {
+            "input_norm": {"scale": _np(hf_state_dict[hf + "input_layernorm.weight"])},
+            "post_attn_norm": {"scale": _np(hf_state_dict[hf + "post_attention_layernorm.weight"])},
+            "attn": {
+                "q_proj": {"kernel": _lin(hf + "self_attn.q_proj.weight")},
+                "k_proj": {"kernel": _lin(hf + "self_attn.k_proj.weight")},
+                "v_proj": {"kernel": _lin(hf + "self_attn.v_proj.weight")},
+                "o_proj": {"kernel": _lin(hf + "self_attn.o_proj.weight")},
+            },
+            "moe": {
+                "gate": {"kernel": _lin(moe + "gate.weight")},
+                "w1": np.stack([_lin(moe + f"experts.{j}.w1.weight")
+                                for j in range(config.num_experts)]),
+                "w3": np.stack([_lin(moe + f"experts.{j}.w3.weight")
+                                for j in range(config.num_experts)]),
+                "w2": np.stack([_lin(moe + f"experts.{j}.w2.weight")
+                                for j in range(config.num_experts)]),
+            },
+        }
+    return p

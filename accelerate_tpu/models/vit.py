@@ -1,0 +1,205 @@
+"""ViT: Vision Transformer for image classification.
+
+Capability parity: the reference trains any torch vision model (its
+`examples/cv_example.py` uses timm resnet50); ViT is the transformer-native
+vision family for this framework, with an HF `ViTForImageClassification` weight
+mapping (reference checkpoint ingestion analogue, `utils/modeling.py:1611`).
+
+TPU notes: patch embedding is extract-patches + one matmul (identical math to
+HF's strided Conv2d but expressed as a dense op the MXU tiles directly);
+attention is bidirectional over `num_patches + 1` tokens so sequence lengths
+stay static; pre-LN blocks keep residuals in the compute dtype.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_labels: int = 1000
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def base(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw) -> "ViTConfig":
+        return cls(**{**dict(hidden_size=1024, num_layers=24, num_heads=16), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        return cls(**{**dict(image_size=32, patch_size=8, hidden_size=64,
+                             num_layers=2, num_heads=4, num_labels=10), **kw})
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def patchify(pixel_values: jax.Array, patch: int) -> jax.Array:
+    """[B, C, H, W] -> [B, n_patches, C*patch*patch], channel-major per patch
+    (the flattening order of a torch Conv2d kernel, so HF weights map 1:1)."""
+    b, c, h, w = pixel_values.shape
+    x = pixel_values.reshape(b, c, h // patch, patch, w // patch, patch)
+    x = x.transpose(0, 2, 4, 1, 3, 5)  # [B, gh, gw, C, ph, pw]
+    return x.reshape(b, (h // patch) * (w // patch), c * patch * patch)
+
+
+class ViTSelfAttention(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, s, e = x.shape
+        head_dim = e // cfg.num_heads
+        dense = lambda name: nn.Dense(e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+        q = dense("query")(x).reshape(b, s, cfg.num_heads, head_dim)
+        k = dense("key")(x).reshape(b, s, cfg.num_heads, head_dim)
+        v = dense("value")(x).reshape(b, s, cfg.num_heads, head_dim)
+        out = dot_product_attention(q, k, v, causal=False)
+        return dense("out")(out.reshape(b, s, e))
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                                       param_dtype=cfg.param_dtype, name=name)
+        x = x + ViTSelfAttention(cfg, name="attn")(ln("ln_before")(x).astype(cfg.dtype))
+        h = ln("ln_after")(x).astype(cfg.dtype)
+        h = nn.Dense(cfg.mlp_ratio * cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlp_up")(h)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="mlp_down")(h)
+        return x + h
+
+
+class ViTForImageClassification(nn.Module):
+    """Returns fp32 logits [batch, num_labels]; input [B, C, H, W] (HF layout)."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixel_values: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        patches = patchify(pixel_values.astype(cfg.dtype), cfg.patch_size)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="patch_embed")(patches)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size),
+                         cfg.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(cfg.dtype),
+                                              (x.shape[0], 1, cfg.hidden_size)), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.hidden_size), cfg.param_dtype)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="ln_final")(x)
+        cls_out = x[:, 0].astype(jnp.float32)  # keep the fp32 LayerNorm output
+        return nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                        name="classifier")(cls_out)
+
+    def init_params(self, rng: jax.Array, batch: int = 2) -> Any:
+        cfg = self.config
+        shape = (batch, cfg.num_channels, cfg.image_size, cfg.image_size)
+        return self.init(rng, jnp.zeros(shape, cfg.dtype))["params"]
+
+
+def vit_sharding_rules() -> ShardingRules:
+    """TP: qkv/up column-parallel, out/down row-parallel (Megatron split)."""
+    return ShardingRules(
+        rules=[
+            (r".*attn/(query|key|value)/kernel", P(None, "tensor")),
+            (r".*attn/out/kernel", P("tensor", None)),
+            (r".*mlp_up/kernel", P(None, "tensor")),
+            (r".*mlp_down/kernel", P("tensor", None)),
+        ]
+    )
+
+
+def vit_loss_fn(model, batch) -> jax.Array:
+    import optax
+
+    logits = model(batch["pixel_values"])
+    return optax.softmax_cross_entropy_with_integer_labels(logits, batch["labels"]).mean()
+
+
+def params_from_hf_vit(hf_state_dict: dict, config: ViTConfig) -> dict:
+    """Map HF transformers ViTForImageClassification weights into this layout.
+    The Conv2d patch projection [hidden, C, ph, pw] flattens to a dense kernel
+    [C*ph*pw, hidden] (same contraction order as `patchify`)."""
+
+    def _np(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+    def _lin(key):
+        return _np(hf_state_dict[key]).T
+
+    def _ln(prefix):
+        return {"scale": _np(hf_state_dict[prefix + ".weight"]),
+                "bias": _np(hf_state_dict[prefix + ".bias"])}
+
+    conv = _np(hf_state_dict["vit.embeddings.patch_embeddings.projection.weight"])
+    p: dict[str, Any] = {
+        "patch_embed": {
+            "kernel": conv.reshape(conv.shape[0], -1).T,
+            "bias": _np(hf_state_dict["vit.embeddings.patch_embeddings.projection.bias"]),
+        },
+        "cls_token": _np(hf_state_dict["vit.embeddings.cls_token"]),
+        "pos_embed": _np(hf_state_dict["vit.embeddings.position_embeddings"]),
+        "ln_final": _ln("vit.layernorm"),
+        "classifier": {
+            "kernel": _lin("classifier.weight"),
+            "bias": _np(hf_state_dict["classifier.bias"]),
+        },
+    }
+    for i in range(config.num_layers):
+        hf = f"vit.encoder.layer.{i}."
+        att = hf + "attention.attention."
+        p[f"block_{i}"] = {
+            "ln_before": _ln(hf + "layernorm_before"),
+            "ln_after": _ln(hf + "layernorm_after"),
+            "attn": {
+                "query": {"kernel": _lin(att + "query.weight"),
+                          "bias": _np(hf_state_dict[att + "query.bias"])},
+                "key": {"kernel": _lin(att + "key.weight"),
+                        "bias": _np(hf_state_dict[att + "key.bias"])},
+                "value": {"kernel": _lin(att + "value.weight"),
+                          "bias": _np(hf_state_dict[att + "value.bias"])},
+                "out": {"kernel": _lin(hf + "attention.output.dense.weight"),
+                        "bias": _np(hf_state_dict[hf + "attention.output.dense.bias"])},
+            },
+            "mlp_up": {"kernel": _lin(hf + "intermediate.dense.weight"),
+                       "bias": _np(hf_state_dict[hf + "intermediate.dense.bias"])},
+            "mlp_down": {"kernel": _lin(hf + "output.dense.weight"),
+                         "bias": _np(hf_state_dict[hf + "output.dense.bias"])},
+        }
+    return p

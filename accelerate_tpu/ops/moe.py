@@ -45,6 +45,51 @@ class MoEConfig:
     param_dtype: Any = jnp.float32
 
 
+def build_dispatch_combine(
+    expert_idx: jax.Array,  # [T, k] chosen experts per token
+    gate_vals: jax.Array,  # [T, k] combine weights per choice
+    num_experts: int,
+    capacity: int,
+    dtype: Any,
+) -> tuple[jax.Array, jax.Array]:
+    """Static-capacity dispatch/combine one-hots [T, E, C] (GShard recipe).
+
+    Position of each token within its expert's capacity buffer comes from a
+    masked cumsum; slots are processed in order, later slots offset by earlier
+    slots' fill counts. Tokens beyond capacity are dropped (their dispatch and
+    combine rows stay zero, so they pass through the residual stream).
+    Shared by `MoEMLP` and `models.mixtral.MixtralSparseMoeBlock`.
+    """
+    n_tokens, k = expert_idx.shape
+    E = num_experts
+    dispatch = jnp.zeros((n_tokens, E, capacity), dtype=dtype)
+    combine = jnp.zeros((n_tokens, E, capacity), dtype=jnp.float32)
+    fill = jnp.zeros((E,), dtype=jnp.float32)
+    for slot in range(k):
+        onehot = jax.nn.one_hot(expert_idx[:, slot], E, dtype=jnp.float32)  # [T, E]
+        within = jnp.cumsum(onehot, axis=0) - onehot  # earlier tokens, this slot
+        pos_in_expert = jnp.sum((within + fill[None, :]) * onehot, axis=-1)  # [T]
+        keep = pos_in_expert < capacity
+        pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)
+        contrib = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + contrib.astype(dtype)
+        combine = combine + contrib * gate_vals[:, slot][:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+    return dispatch, combine
+
+
+def sow_aux_loss(module: nn.Module, aux: jax.Array) -> None:
+    """Sum-reduce sow of a router aux loss into ``intermediates`` (stable pytree
+    across steps; see the MoEMLP docstring for why sum-reduce, not append)."""
+    module.sow(
+        "intermediates",
+        "aux_loss",
+        aux,
+        reduce_fn=lambda prev, new: prev + new,
+        init_fn=lambda: jnp.zeros((), jnp.float32),
+    )
+
+
 class MoEMLP(nn.Module):
     """Top-k routed expert MLP over [batch, seq, hidden] activations."""
 
@@ -68,21 +113,9 @@ class MoEMLP(nn.Module):
         gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)  # [T, k]
         gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
-        # position of each token within its expert's capacity buffer; slots are
-        # processed in order, later slots offset by earlier slots' fill counts
-        dispatch = jnp.zeros((n_tokens, E, capacity), dtype=cfg.dtype)
-        combine = jnp.zeros((n_tokens, E, capacity), dtype=jnp.float32)
-        fill = jnp.zeros((E,), dtype=jnp.float32)
-        for slot in range(cfg.top_k):
-            onehot = jax.nn.one_hot(expert_idx[:, slot], E, dtype=jnp.float32)  # [T, E]
-            within = jnp.cumsum(onehot, axis=0) - onehot  # earlier tokens, this slot
-            pos_in_expert = jnp.sum((within + fill[None, :]) * onehot, axis=-1)  # [T]
-            keep = pos_in_expert < capacity
-            pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T, C]
-            contrib = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
-            dispatch = dispatch + contrib.astype(cfg.dtype)
-            combine = combine + contrib * gate_vals[:, slot][:, None, None]
-            fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+        dispatch, combine = build_dispatch_combine(
+            expert_idx, gate_vals, E, capacity, cfg.dtype
+        )
 
         # expert-stacked weights: leading dim shards over the tensor axis (EP)
         w_up = self.param("w_up", nn.initializers.lecun_normal(),
@@ -107,13 +140,7 @@ class MoEMLP(nn.Module):
         me = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
         ce = jnp.mean(probs, axis=0)
         aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
-        self.sow(
-            "intermediates",
-            "aux_loss",
-            aux,
-            reduce_fn=lambda prev, new: prev + new,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-        )
+        sow_aux_loss(self, aux)
         return out.reshape(b, s, e).astype(x.dtype)
 
 

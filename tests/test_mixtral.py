@@ -1,0 +1,101 @@
+"""Mixtral: HF forward parity (drop-free capacity), expert-parallel training,
+aux-loss threading through the facade."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    mixtral_loss_fn,
+    mixtral_sharding_rules,
+    params_from_hf_mixtral,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _fresh(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def test_forward_parity_with_hf_transformers():
+    """Random-init HF Mixtral vs our model with mapped weights. HF routes every
+    token (no capacity limit), so run drop-free: capacity >= 2T covers the worst
+    case of one expert taking every token in both top-2 slots."""
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig as HFConfig, MixtralForCausalLM as HFMixtral
+
+    torch.manual_seed(0)
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    hf_model = HFMixtral(hf_cfg).eval()
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, num_experts=4, top_k=2,
+        max_position_embeddings=64, rope_theta=10000.0, dtype=jnp.float32,
+        capacity_factor=2 * 4 / 2,  # capacity = cf*T*k/E = 2T: drop-free
+    )
+    params = params_from_hf_mixtral(hf_model.state_dict(), cfg)
+    ids = torch.randint(0, 128, (2, 12))
+    with torch.no_grad():
+        ref = hf_model(ids).logits.numpy()
+    ours = MixtralForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids.numpy()))
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=5e-4, rtol=1e-3)
+
+
+def test_expert_parallel_training():
+    """EP over the tensor axis: expert-stacked weights shard their leading dim,
+    training drives the LM loss down, aux loss flows through extra_state."""
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    module = MixtralForCausalLM(cfg)
+    params = module.init_params(jax.random.key(0), batch=2, seq=16)
+
+    acc = _fresh(
+        parallelism_config=ParallelismConfig(data_parallel_size=2, tensor_size=4),
+        sharding_rules=mixtral_sharding_rules(),
+    )
+    rng = np.random.default_rng(0)
+    # two fixed batches repeated: the model memorizes them, so loss must fall
+    uniq = [
+        {"input_ids": rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)}
+        for _ in range(2)
+    ]
+    model, opt, dl = acc.prepare(
+        (module, {"params": params, "intermediates": {}}),
+        optax.adam(1e-2),
+        DataLoaderShard(uniq * 8),
+    )
+    # EP engaged: expert-stacked w1 sharded over 'tensor' on its leading dim
+    w1 = model.params["layer_0"]["moe"]["w1"]
+    assert "tensor" in jax.tree.leaves(w1)[0].sharding.spec[0:1] or \
+        w1.sharding.spec[0] == "tensor"
+
+    step = acc.make_train_step(mixtral_loss_fn)
+    losses = [float(step(b)) for b in dl]
+    assert losses[-1] < losses[0]
+    # router aux loss was sown and collected (nonzero scalar in extra_state)
+    aux = model.extra_state["intermediates"]
+    assert jax.tree.leaves(aux), "aux losses missing from intermediates"
+
+
+def test_capacity_drops_pass_through_residual():
+    """With capacity 0-ish (factor tiny), the MoE contributes ~nothing and the
+    block reduces to attention-only residuals — must still be finite."""
+    cfg = MixtralConfig.tiny(dtype=jnp.float32, capacity_factor=0.01)
+    module = MixtralForCausalLM(cfg)
+    params = module.init_params(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 8)), dtype=jnp.int32)
+    out = module.apply({"params": params}, ids)
+    assert np.isfinite(np.asarray(out)).all()
