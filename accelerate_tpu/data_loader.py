@@ -312,7 +312,13 @@ class DataLoaderShard:
         total_batch_size: int | None = None,
         even_batches: bool = True,
         _drop_last: bool = False,
+        prefetch: str = "none",
+        prefetch_slot_bytes: int = 256 << 20,
     ):
+        if prefetch not in ("none", "auto", "native"):
+            raise ValueError(f"prefetch must be none|auto|native, got {prefetch!r}")
+        self.prefetch = prefetch
+        self.prefetch_slot_bytes = prefetch_slot_bytes
         self.base_loader = base_loader
         self.device_placement = device_placement
         self.mesh = mesh
@@ -393,7 +399,23 @@ class DataLoaderShard:
             def _mark_last():
                 self.end_of_dataloader = True
 
-            it = _PrefetchIterator(iter(self.base_loader), _mark_last)
+            base_it = iter(self.base_loader)
+            if self.prefetch in ("auto", "native"):
+                # C++ staging ring: host batch assembly + aligned gather-copy of
+                # batch i+1 overlap device compute on batch i (native/).
+                # Wrapped INSIDE the lookahead iterator so end_of_dataloader
+                # still flips exactly when the final batch is yielded.
+                from .native import HostPrefetcher, is_native_available, native_unavailable_reason
+
+                if is_native_available():
+                    base_it = iter(
+                        HostPrefetcher(base_it, slot_bytes=self.prefetch_slot_bytes)
+                    )
+                elif self.prefetch == "native":
+                    raise RuntimeError(
+                        f"prefetch='native' requested but {native_unavailable_reason()}"
+                    )
+            it = _PrefetchIterator(base_it, _mark_last)
             for idx, batch in enumerate(it):
                 if idx < self.skip_batches:
                     continue

@@ -1,0 +1,111 @@
+"""Batch prefetcher over the native staging ring.
+
+A producer thread drains the base iterator (Python-side batch assembly) and
+pushes each batch's leaves into the C++ ring, whose worker gather-copies them
+into one aligned staging slot; the consumer pops slots FIFO and yields the batch
+reconstructed as zero-copy views. Net effect: host batch assembly AND the
+staging copy of batch i+1 overlap device compute on batch i — the reference gets
+this from torch DataLoader workers + pinned-memory prefetch (reference
+`data_loader.py:550-573`).
+
+Popped batches are materialized as owning arrays (one fast memcpy out of the
+aligned slot) and the slot recycles immediately — yielded batches have normal
+numpy lifetimes, safe to hold past the iterator (JAX's async H2D may read them
+any time later).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator
+
+import jax
+import numpy as np
+
+
+def _flatten(batch: Any):
+    """Pytree of arraylikes -> (numpy leaves, rebuild) via jax.tree. Returns
+    (None, None) when any leaf is not a plain numeric/bool buffer (object
+    dtypes hold PyObject pointers — memcpy'ing those would be garbage)."""
+    raw, treedef = jax.tree.flatten(batch)
+    leaves = []
+    for leaf in raw:
+        arr = np.asarray(leaf)
+        if arr.dtype.hasobject:
+            return None, None
+        leaves.append(arr)
+    return leaves, treedef.unflatten
+
+
+class HostPrefetcher:
+    """Iterate ``base`` through the native staging ring (see module docstring).
+
+    Falls back to plain iteration when the native library is unavailable or a
+    batch exceeds ``slot_bytes`` — identical output either way.
+    """
+
+    def __init__(
+        self,
+        base: Iterable,
+        depth: int = 3,
+        slot_bytes: int = 256 << 20,
+    ):
+        self.base = base
+        self.depth = max(depth, 2)
+        self.slot_bytes = slot_bytes
+
+    def __iter__(self) -> Iterator[Any]:
+        from . import PrefetchRing, is_native_available
+
+        if not is_native_available():
+            yield from self.base
+            return
+
+        ring = PrefetchRing(self.depth, self.slot_bytes)
+        meta: "queue.Queue" = queue.Queue()
+        _SENTINEL = object()
+        error: list[BaseException] = []
+
+        def producer():
+            try:
+                for batch in self.base:
+                    leaves, rebuild = _flatten(batch)
+                    if leaves is None:  # non-numeric leaves: not stageable
+                        meta.put(("bypass", batch, None))
+                        continue
+                    total = sum(-(-a.nbytes // 64) * 64 for a in leaves)
+                    if total > self.slot_bytes:
+                        meta.put(("bypass", batch, None))
+                        continue
+                    ring.push(leaves)  # blocks when the ring is full
+                    meta.put(("slot", [(a.shape, a.dtype) for a in leaves], rebuild))
+            except BaseException as e:  # surface in the consumer
+                error.append(e)
+            finally:
+                meta.put((_SENTINEL, None, None))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                kind, payload, rebuild = meta.get()
+                if kind is _SENTINEL:
+                    break
+                if kind == "bypass":
+                    yield payload
+                    continue
+                arrays, _ = ring.pop(payload, copy=True)
+                ring.release()  # owning copies made; recycle the slot now
+                yield rebuild(arrays)
+            if error:
+                raise error[0]
+        finally:
+            # stop first: the producer may be blocked inside ring_push_batch, and
+            # destroying the ring under it would be a use-after-free
+            ring.stop()
+            t.join(timeout=5)
+            if t.is_alive():
+                ring._h = None  # leak rather than free under a live thread
+            else:
+                ring.close()

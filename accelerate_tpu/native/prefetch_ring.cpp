@@ -1,0 +1,224 @@
+// Host-side prefetch ring: background gather-copy of batch buffers into
+// 64-byte-aligned staging slots.
+//
+// Role in the framework (see native/__init__.py): the reference overlaps host
+// batch preparation with device compute through torch DataLoader worker
+// processes + pinned-memory copies (reference `data_loader.py:550-573` prefetch,
+// `MpDeviceLoaderWrapper` background threads). Here the copy path is native: a
+// worker thread drains a job queue, memcpy-gathers each batch's leaves into one
+// contiguous aligned slot (releasing the Python GIL for the whole copy), and
+// hands ready slots to the consumer FIFO. Alignment matters for the downstream
+// host->device DMA and lets the CPU backend ingest buffers zero-copy.
+//
+// States per slot: FREE -> QUEUED -> READY -> POPPED -> FREE. Push blocks when
+// every slot is in flight (backpressure = bounded prefetch depth). All calls are
+// thread-safe; one consumer and any number of producers.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+
+enum SlotState : int { FREE = 0, QUEUED = 1, READY = 2, POPPED = 3 };
+
+struct Segment {
+  const void* src;
+  size_t nbytes;
+};
+
+struct Job {
+  int slot;
+  long id;
+  std::vector<Segment> segs;
+};
+
+struct Slot {
+  uint8_t* buf = nullptr;
+  size_t capacity = 0;
+  size_t used = 0;
+  long job_id = -1;
+  int state = FREE;
+};
+
+struct Ring {
+  std::vector<Slot> slots;
+  std::queue<Job> jobs;
+  std::queue<int> ready;
+  std::queue<int> popped;
+  std::mutex mu;
+  std::condition_variable cv_job;    // worker waits for jobs
+  std::condition_variable cv_ready;  // consumer waits for ready slots
+  std::condition_variable cv_free;   // producer waits for a free slot
+  std::thread worker;
+  bool stopping = false;
+  long next_job_id = 0;
+  long completed = 0;  // jobs whose source buffers are no longer needed
+
+  void run() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_job.wait(lk, [&] { return stopping || !jobs.empty(); });
+        if (stopping && jobs.empty()) return;
+        job = std::move(jobs.front());
+        jobs.pop();
+      }
+      Slot& slot = slots[job.slot];
+      uint8_t* dst = slot.buf;
+      size_t off = 0;
+      for (const Segment& s : job.segs) {
+        std::memcpy(dst + off, s.src, s.nbytes);
+        // next segment starts at the next 64-byte boundary
+        off += (s.nbytes + kAlign - 1) / kAlign * kAlign;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot.used = off;
+        slot.job_id = job.id;
+        slot.state = READY;
+        ready.push(job.slot);
+        completed = job.id + 1;
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+size_t aligned_total(const size_t* sizes, int count) {
+  size_t total = 0;
+  for (int i = 0; i < count; ++i) {
+    total += (sizes[i] + kAlign - 1) / kAlign * kAlign;
+  }
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ring_create(int n_slots, size_t slot_bytes) {
+  if (n_slots < 1) return nullptr;
+  Ring* r = new Ring();
+  r->slots.resize(n_slots);
+  for (Slot& s : r->slots) {
+    s.capacity = slot_bytes;
+    s.buf = static_cast<uint8_t*>(
+        std::aligned_alloc(kAlign, (slot_bytes + kAlign - 1) / kAlign * kAlign));
+    if (s.buf == nullptr) {
+      for (Slot& t : r->slots) std::free(t.buf);
+      delete r;
+      return nullptr;
+    }
+  }
+  r->worker = std::thread([r] { r->run(); });
+  return r;
+}
+
+// Enqueue an async gather-copy of `count` segments into one slot. Returns the
+// job id (>= 0), or -1 if the segments exceed the slot capacity. Source buffers
+// must stay valid until ring_completed() > job id. Blocks while all slots are
+// in flight.
+long ring_push_batch(void* h, const void** srcs, const size_t* sizes, int count) {
+  Ring* r = static_cast<Ring*>(h);
+  if (aligned_total(sizes, count) > r->slots[0].capacity) return -1;
+  Job job;
+  job.segs.reserve(count);
+  for (int i = 0; i < count; ++i) job.segs.push_back({srcs[i], sizes[i]});
+  int slot_idx = -1;
+  long job_id = -1;
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv_free.wait(lk, [&] {
+      if (r->stopping) return true;
+      for (size_t i = 0; i < r->slots.size(); ++i) {
+        if (r->slots[i].state == FREE) {
+          slot_idx = static_cast<int>(i);
+          return true;
+        }
+      }
+      return false;
+    });
+    if (r->stopping || slot_idx < 0) return -2;
+    r->slots[slot_idx].state = QUEUED;
+    job.slot = slot_idx;
+    job.id = job_id = r->next_job_id++;
+    r->jobs.push(std::move(job));
+  }
+  r->cv_job.notify_one();
+  // job_id was captured under the lock: reading next_job_id here would race
+  // with concurrent producers and return another producer's id
+  return job_id;
+}
+
+// Block until a slot is ready; returns its base pointer and byte count. Slots
+// come out in push order (FIFO). Returns nullptr if the ring is stopping.
+const void* ring_pop(void* h, size_t* out_nbytes, long* out_job_id) {
+  Ring* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_ready.wait(lk, [&] { return r->stopping || !r->ready.empty(); });
+  if (r->ready.empty()) return nullptr;
+  int idx = r->ready.front();
+  r->ready.pop();
+  Slot& s = r->slots[idx];
+  s.state = POPPED;
+  r->popped.push(idx);
+  if (out_nbytes) *out_nbytes = s.used;
+  if (out_job_id) *out_job_id = s.job_id;
+  return s.buf;
+}
+
+// Free the oldest popped slot for reuse. The consumer must be done with every
+// view into it.
+void ring_release(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    if (r->popped.empty()) return;
+    int idx = r->popped.front();
+    r->popped.pop();
+    r->slots[idx].state = FREE;
+  }
+  r->cv_free.notify_one();
+}
+
+// Number of completed copy jobs: sources of jobs with id < this are reusable.
+long ring_completed(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->completed;
+}
+
+// Wake every blocked producer/consumer with a "shutting down" result WITHOUT
+// freeing the ring. Call this, join any threads still inside ring_* calls, then
+// ring_destroy — destroying while a call is blocked is a use-after-free.
+void ring_stop(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stopping = true;
+  }
+  r->cv_job.notify_all();
+  r->cv_ready.notify_all();
+  r->cv_free.notify_all();
+}
+
+void ring_destroy(void* h) {
+  Ring* r = static_cast<Ring*>(h);
+  ring_stop(h);
+  if (r->worker.joinable()) r->worker.join();
+  for (Slot& s : r->slots) std::free(s.buf);
+  delete r;
+}
+
+size_t ring_alignment() { return kAlign; }
+
+}  // extern "C"

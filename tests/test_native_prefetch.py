@@ -1,0 +1,201 @@
+"""Native C++ prefetch ring: build, FIFO round-trip, alignment, backpressure,
+HostPrefetcher equivalence, DataLoaderShard integration, and graceful fallback."""
+
+import os
+import subprocess
+import threading
+
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.native import (
+    PrefetchRing,
+    is_native_available,
+    native_unavailable_reason,
+)
+from accelerate_tpu.native.host_prefetcher import HostPrefetcher
+
+native = pytest.mark.skipif(
+    not is_native_available(), reason=f"no native build: {native_unavailable_reason()}"
+)
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "x": rng.normal(size=(8, 16)).astype(np.float32),
+            "labels": rng.integers(0, 4, size=(8,)).astype(np.int32),
+        }
+        for _ in range(n)
+    ]
+
+
+@native
+class TestPrefetchRing:
+    def test_fifo_round_trip(self):
+        ring = PrefetchRing(n_slots=2, slot_bytes=1 << 16)
+        try:
+            a = np.arange(100, dtype=np.float32)
+            b = np.arange(7, dtype=np.int64) * 3
+            ring.push([a, b])
+            # zero-copy views: valid until release; slots are 64-byte aligned
+            views, job = ring.pop([(a.shape, a.dtype), (b.shape, b.dtype)], copy=False)
+            assert job == 0
+            np.testing.assert_array_equal(views[0], a)
+            np.testing.assert_array_equal(views[1], b)
+            for v in views:
+                assert v.ctypes.data % 64 == 0
+            del views
+            ring.release()
+        finally:
+            ring.close()
+
+    def test_ordering_across_many_batches(self):
+        ring = PrefetchRing(n_slots=3, slot_bytes=1 << 16)
+        try:
+            arrays = [np.full((32,), i, dtype=np.int32) for i in range(3)]
+            for a in arrays:
+                ring.push([a])
+            for i in range(3):
+                views, job = ring.pop([((32,), np.int32)])
+                assert job == i
+                assert views[0][0] == i
+                ring.release()
+        finally:
+            ring.close()
+
+    def test_oversized_batch_rejected(self):
+        ring = PrefetchRing(n_slots=2, slot_bytes=128)
+        try:
+            with pytest.raises(ValueError, match="exceeds slot capacity"):
+                ring.push([np.zeros(1000, np.float32)])
+        finally:
+            ring.close()
+
+    def test_backpressure_blocks_then_drains(self):
+        """Pushing more batches than slots must block until the consumer pops."""
+        ring = PrefetchRing(n_slots=2, slot_bytes=1 << 12)
+        try:
+            pushed = []
+
+            def producer():
+                for i in range(5):
+                    ring.push([np.full((16,), i, dtype=np.int32)])
+                    pushed.append(i)
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            t.join(timeout=1.0)
+            assert t.is_alive(), "producer should be blocked on the full ring"
+            assert len(pushed) <= 3  # 2 slots + possibly one queued push
+            for i in range(5):
+                views, _ = ring.pop([((16,), np.int32)])
+                assert views[0][0] == i
+                ring.release()
+            t.join(timeout=5.0)
+            assert not t.is_alive() and pushed == [0, 1, 2, 3, 4]
+        finally:
+            ring.close()
+
+    def test_completed_tracks_source_reuse(self):
+        ring = PrefetchRing(n_slots=2, slot_bytes=1 << 12)
+        try:
+            job = ring.push([np.ones(8, np.float32)])
+            ring.pop([((8,), np.float32)])  # pop implies the copy completed
+            assert ring.completed() >= job + 1
+            ring.release()
+        finally:
+            ring.close()
+
+
+@native
+def test_host_prefetcher_yields_identical_batches():
+    base = _batches(7)
+    out = list(HostPrefetcher(base, depth=3))
+    assert len(out) == len(base)
+    for got, want in zip(out, base):
+        np.testing.assert_array_equal(got["x"], want["x"])
+        np.testing.assert_array_equal(got["labels"], want["labels"])
+
+
+@native
+def test_host_prefetcher_non_numeric_leaves_bypass():
+    """Object-dtype leaves (None, strings) cannot be memcpy-staged; the whole
+    batch must take the bypass path unchanged."""
+    base = [{"x": np.ones((4, 2), np.float32), "meta": None},
+            {"x": np.zeros((4, 2), np.float32), "meta": ["a", "bc"]}]
+    out = list(HostPrefetcher(base, depth=3))
+    assert out[0]["meta"] is None and out[1]["meta"] == ["a", "bc"]
+    np.testing.assert_array_equal(out[0]["x"], base[0]["x"])
+    np.testing.assert_array_equal(out[1]["x"], base[1]["x"])
+
+
+@native
+def test_host_prefetcher_oversized_batches_bypass():
+    base = _batches(3)
+    out = list(HostPrefetcher(base, depth=3, slot_bytes=64))  # everything bypasses
+    for got, want in zip(out, base):
+        np.testing.assert_array_equal(got["x"], want["x"])
+
+
+@native
+def test_dataloader_native_prefetch_trains_identically():
+    import jax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    def train(prefetch):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc = Accelerator()
+        params = {"w": np.zeros((16, 4), np.float32)}
+
+        def apply_fn(p, x):
+            return x @ p["w"]
+
+        def loss_fn(m, batch):
+            import jax.numpy as jnp
+            import optax as ox
+
+            return ox.softmax_cross_entropy_with_integer_labels(
+                m(batch["x"]), batch["labels"]
+            ).mean()
+
+        model, opt, dl = acc.prepare(
+            (apply_fn, params), optax.sgd(0.1),
+            DataLoaderShard(_batches(6, seed=3), prefetch=prefetch),
+        )
+        step = acc.make_train_step(loss_fn)
+        losses = [float(step(b)) for b in dl]
+        return losses, jax.tree.map(np.asarray, acc.get_state_dict(model))
+
+    losses_none, params_none = train("none")
+    losses_native, params_native = train("native")
+    np.testing.assert_allclose(losses_native, losses_none, rtol=1e-6)
+    np.testing.assert_allclose(params_native["w"], params_none["w"], rtol=1e-6)
+
+
+def test_disable_env_forces_fallback():
+    code = (
+        "import os; os.environ['ACCELERATE_TPU_DISABLE_NATIVE']='1';"
+        "from accelerate_tpu.native import is_native_available, native_unavailable_reason;"
+        "from accelerate_tpu.native.host_prefetcher import HostPrefetcher;"
+        "import numpy as np;"
+        "assert not is_native_available();"
+        "assert 'disabled' in native_unavailable_reason();"
+        "base=[{'x': np.ones((2,2))}];"
+        "out=list(HostPrefetcher(base));"
+        "assert np.array_equal(out[0]['x'], base[0]['x']);"
+        "print('fallback ok')"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        ["python", "-c", code], capture_output=True, text=True, env=env, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+    assert "fallback ok" in out.stdout
