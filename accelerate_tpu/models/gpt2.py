@@ -48,6 +48,7 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str | None = None  # see utils/remat.py: full|dots|dots_no_batch
     scan_layers: bool = False
     attention_impl: str = "auto"  # 'xla' | 'flash' | 'auto'
 
@@ -178,7 +179,9 @@ class GPT2LMHead(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            from ..utils.remat import remat_block
+
+            block = remat_block(Block, cfg.remat_policy, static_argnums=(2, 3))
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, deterministic, decode), None),

@@ -39,6 +39,7 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
 
     @classmethod
@@ -187,7 +188,12 @@ class LlamaForCausalLM(nn.Module):
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         x = embed.astype(cfg.dtype)[input_ids]
-        block = nn.remat(LlamaBlock, prevent_cse=False) if cfg.remat else LlamaBlock
+        if cfg.remat:
+            from ..utils.remat import remat_block
+
+            block = remat_block(LlamaBlock, cfg.remat_policy, static_argnums=(2,))
+        else:
+            block = LlamaBlock
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)

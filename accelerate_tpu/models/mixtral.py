@@ -48,6 +48,7 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
 
     @classmethod
@@ -166,7 +167,12 @@ class MixtralForCausalLM(nn.Module):
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         x = embed.astype(cfg.dtype)[input_ids]
-        block = nn.remat(MixtralBlock, prevent_cse=False) if cfg.remat else MixtralBlock
+        if cfg.remat:
+            from ..utils.remat import remat_block
+
+            block = remat_block(MixtralBlock, cfg.remat_policy, static_argnums=(2,))
+        else:
+            block = MixtralBlock
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)

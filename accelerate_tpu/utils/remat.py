@@ -1,0 +1,61 @@
+"""Rematerialization policies: the HBM <-> FLOPs dial.
+
+The reference exposes activation checkpointing as engine flags (FSDP
+`activation_checkpointing`, `accelerator.py:1531-1540`; DeepSpeed config;
+Megatron `--recompute-*`). TPU-native this is `jax.checkpoint` with a
+save-policy; the named policies below pick what XLA keeps in HBM across the
+forward pass:
+
+- ``"full"``     — save nothing, recompute everything in backward (max memory
+                   savings, ~33% more FLOPs).
+- ``"dots"``     — save matmul outputs only (`checkpoint_dots`): elementwise/
+                   norm ops recompute, the MXU work does not. Usually the best
+                   throughput-per-byte trade on TPU.
+- ``"dots_no_batch"`` — `dots_with_no_batch_dims_saveable`: like "dots" but
+                   batched matmuls (attention scores) also recompute.
+- ``"nothing"``  — alias of "full".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+_POLICIES: dict[str, Any] = {
+    "full": None,
+    "nothing": None,
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def resolve_remat_policy(name: str | None) -> Any:
+    """Map a policy name to a `jax.checkpoint` policy callable (None = save
+    nothing). Accepts a callable directly for custom policies."""
+    if name is None or callable(name):
+        return name
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown remat policy {name!r}; choose from {sorted(_POLICIES)} "
+            "or pass a jax.checkpoint_policies callable."
+        ) from None
+
+
+def remat_block(block_cls, policy_name: str | None = None, static_argnums: tuple = ()):
+    """nn.remat a flax block class under the named policy.
+
+    ``static_argnums`` indexes the block's ``__call__`` positional args with the
+    module instance at 0 — Python-bool flags like ``deterministic``/``decode``
+    MUST be listed or flax traces them and `if flag:` raises
+    TracerBoolConversionError."""
+    import flax.linen as nn
+
+    return nn.remat(
+        block_cls,
+        prevent_cse=False,
+        policy=resolve_remat_policy(policy_name),
+        static_argnums=static_argnums,
+    )

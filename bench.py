@@ -5,11 +5,23 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no training-throughput numbers (BASELINE.md), so
 vs_baseline is reported against the north-star MFU target of 40%:
 vs_baseline = achieved_MFU / 0.40 (>1.0 beats the target).
+
+Env knobs (all optional):
+  BENCH_ITERS / BENCH_BATCH / BENCH_SEQ   timing-loop shape
+  BENCH_ATTN        flash | xla           attention implementation
+  BENCH_SCAN=1      lax.scan over layers (faster compile, one compiled block)
+  BENCH_REMAT       full | dots | dots_no_batch   remat policy (default off)
+  BENCH_PREFETCH=1  feed batches through the native C++ staging ring
+  BENCH_TIMEOUT     watchdog seconds (default 540): if the device never
+                    responds (e.g. dead TPU tunnel), print an error JSON line
+                    and exit instead of hanging the driver.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 
 import jax
@@ -17,43 +29,94 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _arm_watchdog(seconds: int, state: dict) -> None:
+    def fire():
+        if state.get("done"):
+            return
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_train_tokens_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": 0.0,
+                    "detail": {"error": f"watchdog: device unresponsive after {seconds}s",
+                               "stage": state.get("stage", "startup")},
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
+    state = {"done": False, "stage": "startup"}
+    _arm_watchdog(_env_int("BENCH_TIMEOUT", 540), state)
+
     import optax
 
     from accelerate_tpu.accelerator import Accelerator
     from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
+    scan = os.environ.get("BENCH_SCAN", "0") == "1"
+    remat = os.environ.get("BENCH_REMAT", "")
     # GPT-2 small on one v5e chip; CPU fallback uses a tiny config so CI completes
     if on_tpu:
-        cfg = GPT2Config.small(dtype=jnp.bfloat16, attention_impl="flash", remat=False)
-        batch, seq, iters = 8, 1024, 30
+        cfg = GPT2Config.small(
+            dtype=jnp.bfloat16, attention_impl=attn, scan_layers=scan,
+            remat=bool(remat), remat_policy=remat or None,
+        )
+        batch = _env_int("BENCH_BATCH", 8)
+        seq = _env_int("BENCH_SEQ", 1024)
+        iters = _env_int("BENCH_ITERS", 30)
     else:
-        cfg = GPT2Config.tiny(dtype=jnp.float32)
-        batch, seq, iters = 8, 64, 5
+        cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=scan)
+        batch = _env_int("BENCH_BATCH", 8)
+        seq = _env_int("BENCH_SEQ", 64)
+        iters = _env_int("BENCH_ITERS", 5)
 
     acc = Accelerator(mixed_precision="bf16" if on_tpu else "no")
     module = GPT2LMHead(cfg)
+    state["stage"] = "init_params"
     params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
     model, opt = acc.prepare((module, params), optax.adamw(1e-4))
     step = acc.make_train_step(lm_loss_fn)
 
-    ids = jnp.asarray(
-        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)), dtype=jnp.int32
-    )
-    batch_data = {"input_ids": ids}
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    if os.environ.get("BENCH_PREFETCH", "0") == "1":
+        from accelerate_tpu.data_loader import DataLoaderShard
+
+        dl = DataLoaderShard([{"input_ids": ids}] * (iters + 2), prefetch="auto")
+        batches = iter(dl)
+        next_batch = lambda: next(batches)
+    else:
+        jbatch = {"input_ids": jnp.asarray(ids)}
+        next_batch = lambda: jbatch
 
     # warmup/compile; float() forces a device->host transfer, which is the only
     # reliable full sync on relayed TPU backends (block_until_ready can return
     # before remote execution completes)
-    float(step(batch_data))
-    float(step(batch_data))
+    state["stage"] = "compile"
+    float(step(next_batch()))
+    float(step(next_batch()))
 
+    state["stage"] = "timing"
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = step(batch_data)
+        loss = step(next_batch())
     final_loss = float(loss)
     dt = time.perf_counter() - t0
+    state["done"] = True
 
     tokens_per_sec = batch * seq * iters / dt
     n_chips = len(jax.devices())
@@ -78,6 +141,9 @@ def main() -> None:
                     "model": "gpt2-small" if on_tpu else "gpt2-tiny(cpu)",
                     "batch": batch,
                     "seq": seq,
+                    "attn": attn,
+                    "scan": scan,
+                    "remat": remat or "off",
                     "platform": jax.devices()[0].platform,
                     "loss": round(final_loss, 4),
                 },
