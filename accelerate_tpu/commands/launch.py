@@ -95,6 +95,52 @@ def _debug_cpu_launch(n: int, script: str, script_args: list[str], base_env: dic
     return rc
 
 
+def _supervised_launch(
+    script: str,
+    script_args: list[str],
+    base_env: dict[str, str],
+    max_restarts: int,
+    monitor_interval: float,
+) -> int:
+    """Failure-detecting supervisor: run the script as a child process and
+    restart it on nonzero exit, up to ``max_restarts`` times.
+
+    The reference delegates this to torchelastic (`torch.distributed.run`,
+    reference `commands/launch.py:793`; `notebook_launcher` max_restarts /
+    monitor_interval, `launchers.py:40-60`). Under one-process-per-host SPMD the
+    equivalent is a per-host supervisor: the restarted process re-runs
+    `jax.distributed.initialize` and resumes from the latest checkpoint
+    (`Accelerator.load_state` — the by_feature/checkpointing.py pattern).
+    ``ACCELERATE_TPU_RESTART_COUNT`` tells the script which attempt it is on.
+    """
+    import time
+
+    restarts = 0
+    while True:
+        env = dict(os.environ)
+        env.update(base_env)
+        env["ACCELERATE_TPU_RESTART_COUNT"] = str(restarts)
+        proc = subprocess.Popen([sys.executable, script, *script_args], env=env)
+        while proc.poll() is None:
+            time.sleep(monitor_interval)
+        rc = proc.returncode
+        if rc == 0:
+            return 0
+        if restarts >= max_restarts:
+            print(
+                f"[accelerate-tpu launch] script failed (exit {rc}) after "
+                f"{restarts} restart(s); giving up.",
+                file=sys.stderr,
+            )
+            return rc
+        restarts += 1
+        print(
+            f"[accelerate-tpu launch] script failed (exit {rc}); "
+            f"restart {restarts}/{max_restarts}.",
+            file=sys.stderr,
+        )
+
+
 def launch_command(args: argparse.Namespace) -> None:
     cfg = LaunchConfig.from_yaml(Path(args.config_file) if args.config_file else None)
     # CLI overrides (flag > env > config file)
@@ -120,6 +166,15 @@ def launch_command(args: argparse.Namespace) -> None:
     if args.debug_cpu:
         rc = _debug_cpu_launch(args.debug_cpu, args.training_script, args.training_script_args, env)
         sys.exit(rc)
+    if args.max_restarts:
+        rc = _supervised_launch(
+            args.training_script,
+            args.training_script_args,
+            env,
+            max_restarts=args.max_restarts,
+            monitor_interval=args.monitor_interval,
+        )
+        sys.exit(rc)
     os.environ.update(env)
     _run_script(args.training_script, args.training_script_args, module=args.module)
 
@@ -140,6 +195,11 @@ def add_parser(subparsers) -> None:
     p.add_argument("--debug", action="store_true", help="enable collective shape verification")
     p.add_argument("--debug_cpu", type=int, default=None, metavar="N",
                    help="fork N local CPU 'hosts' over a localhost coordinator")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="restart the script on failure up to N times "
+                        "(torchelastic analogue; resume via load_state)")
+    p.add_argument("--monitor_interval", type=float, default=1.0,
+                   help="seconds between child liveness checks under --max_restarts")
     p.add_argument("--module", action="store_true", help="treat script as a python module")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

@@ -134,6 +134,41 @@ class DeepSpeedPlugin(KwargsHandler):
     gradient_accumulation_steps: int = 1
     gradient_clipping: float | None = None
     offload_optimizer_device: str | None = None  # 'cpu' -> host-offloaded opt state
+    hf_ds_config: str | None = None  # path to a ds_config.json ('auto' values OK)
+
+    def __post_init__(self):
+        if self.hf_ds_config:
+            self._apply_ds_config(self.hf_ds_config)
+
+    def _apply_ds_config(self, path: str) -> None:
+        """Ingest a DeepSpeed JSON config file (the reference accepts the same
+        file via `DeepSpeedPlugin(hf_ds_config=...)` / `HfDeepSpeedConfig`,
+        `utils/deepspeed.py:44-170`). 'auto' entries keep this plugin's
+        defaults, as the reference's auto-fill does; engine-only knobs
+        (comm backends, AIO, launcher) are ignored — XLA owns those here."""
+        import json
+
+        with open(path) as f:
+            cfg = json.load(f)
+
+        def _real(v):
+            return v is not None and v != "auto"
+
+        zero = cfg.get("zero_optimization", {})
+        if _real(zero.get("stage")):
+            self.zero_stage = int(zero["stage"])
+        off = zero.get("offload_optimizer", {})
+        if _real(off.get("device")) and off.get("device") != "none":
+            self.offload_optimizer_device = off["device"]
+        if _real(cfg.get("gradient_accumulation_steps")):
+            self.gradient_accumulation_steps = int(cfg["gradient_accumulation_steps"])
+        if _real(cfg.get("gradient_clipping")):
+            self.gradient_clipping = float(cfg["gradient_clipping"])
+        self.mixed_precision = None
+        if cfg.get("bf16", {}).get("enabled") is True:
+            self.mixed_precision = "bf16"
+        elif cfg.get("fp16", {}).get("enabled") is True:
+            self.mixed_precision = "fp16"
 
     def to_parallelism_config(self, num_devices: int) -> ParallelismConfig:
         if self.zero_stage >= 3:

@@ -1,0 +1,101 @@
+"""Elastic restart supervision (`launch --max_restarts`, the torchelastic
+analogue) and DeepSpeed JSON config ingestion."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+CRASHY = """
+import os, sys
+from pathlib import Path
+marker = Path(sys.argv[1])
+attempt = int(os.environ.get("ACCELERATE_TPU_RESTART_COUNT", "0"))
+marker.write_text(str(attempt))
+if attempt < 2:
+    sys.exit(17)  # simulated crash on the first two attempts
+print(f"recovered on attempt {attempt}")
+"""
+
+
+def _launch(tmp_path, extra_args, script_body, script_args=()):
+    script = tmp_path / "train.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "", "PYTHONPATH": str(REPO)})
+    cmd = [
+        sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+        *extra_args, str(script), *[str(a) for a in script_args],
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=300)
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    marker = tmp_path / "attempt.txt"
+    out = _launch(
+        tmp_path,
+        ["--max_restarts", "3", "--monitor_interval", "0.05"],
+        CRASHY,
+        [marker],
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert marker.read_text() == "2"  # third attempt (index 2) succeeded
+    assert "restart 1/3" in out.stderr and "restart 2/3" in out.stderr
+    assert "recovered on attempt 2" in out.stdout
+
+
+def test_supervisor_gives_up_after_max_restarts(tmp_path):
+    marker = tmp_path / "attempt.txt"
+    out = _launch(
+        tmp_path,
+        ["--max_restarts", "1", "--monitor_interval", "0.05"],
+        CRASHY,
+        [marker],
+    )
+    assert out.returncode == 17
+    assert "giving up" in out.stderr
+    assert marker.read_text() == "1"  # ran attempts 0 and 1 only
+
+
+def test_deepspeed_json_config_ingestion(tmp_path):
+    from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
+
+    cfg = {
+        "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+        "gradient_accumulation_steps": 4,
+        "gradient_clipping": 0.7,
+        "bf16": {"enabled": True},
+        "fp16": {"enabled": False},
+        "aio": {"block_size": 1048576},  # engine-only: ignored
+    }
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(cfg))
+    plugin = DeepSpeedPlugin(hf_ds_config=str(path))
+    assert plugin.zero_stage == 3
+    assert plugin.offload_optimizer_device == "cpu"
+    assert plugin.gradient_accumulation_steps == 4
+    assert plugin.gradient_clipping == 0.7
+    assert plugin.mixed_precision == "bf16"
+    pc = plugin.to_parallelism_config(8)
+    assert pc.fsdp_size == -1 and pc.data_parallel_size == 1
+
+
+def test_deepspeed_auto_values_keep_defaults(tmp_path):
+    from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
+
+    cfg = {
+        "zero_optimization": {"stage": "auto", "offload_optimizer": {"device": "none"}},
+        "gradient_accumulation_steps": "auto",
+        "gradient_clipping": "auto",
+    }
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(cfg))
+    plugin = DeepSpeedPlugin(hf_ds_config=str(path))
+    assert plugin.zero_stage == 2  # default preserved
+    assert plugin.offload_optimizer_device is None
+    assert plugin.gradient_accumulation_steps == 1
+    assert plugin.gradient_clipping is None
+    assert plugin.mixed_precision is None
