@@ -286,6 +286,15 @@ class Accelerator:
 
     # ------------------------------------------------------------- topology
     @property
+    def project_dir(self) -> str | None:
+        """Reference `Accelerator.project_dir` (ProjectConfiguration passthrough)."""
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self) -> str | None:
+        return self.project_configuration.logging_dir
+
+    @property
     def partial_state(self) -> PartialState:
         return PartialState()
 

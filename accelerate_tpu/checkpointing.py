@@ -51,12 +51,29 @@ def _save_pytree(path: Path, tree: Any) -> None:
 
 
 def _restore_pytree(path: Path, target: Any | None = None) -> Any:
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .state import AcceleratorState
+
     ocp = _ocp()
+    mesh = AcceleratorState().mesh if AcceleratorState._shared_state else None
+
+    def _sharding_for(x):
+        s = getattr(x, "sharding", None)
+        if isinstance(s, NamedSharding) or mesh is None:
+            return s
+        # Leaves that never went through shard_params (e.g. optax step counters
+        # created by tx.init) live uncommitted on the default device; jit mixes
+        # them freely with mesh-placed params. Orbax restores them COMMITTED to
+        # one device, which jit then rejects next to 8-device params — so
+        # restore such leaves replicated on the mesh instead.
+        return NamedSharding(mesh, PartitionSpec())
+
     with ocp.StandardCheckpointer() as ckptr:
         if target is None:
             return ckptr.restore(path.absolute())
         abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding_for(x))
             if hasattr(x, "shape")
             else x,
             target,

@@ -1,0 +1,115 @@
+"""Complete CV example: ResNet classification with every feature combined
+(reference `examples/complete_cv_example.py`) — tracking, checkpoint/resume,
+LR schedule, gradient accumulation, gathered metrics.
+
+Run:
+    python examples/complete_cv_example.py --tiny --with_tracking
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoaderShard, OptaxSchedule, set_seed
+from accelerate_tpu.accelerator import ProjectConfiguration
+from accelerate_tpu.models.resnet import ResNet, ResNetConfig, image_classification_loss_fn
+
+
+def synthetic_images(n: int, size: int, num_classes: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+    imgs = rng.normal(size=(n, size, size, 3)).astype(np.float32)
+    imgs += labels[:, None, None, None].astype(np.float32) * 0.5
+    return imgs, labels
+
+
+def training_function(args: argparse.Namespace) -> float:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir or "complete_cv_out",
+            automatic_checkpoint_naming=True,
+            total_limit=2,
+        ),
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+    set_seed(args.seed)
+
+    config = ResNetConfig.tiny() if args.tiny else ResNetConfig.resnet50()
+    size = 32 if args.tiny else 224
+    module = ResNet(config)
+    params = module.init_params(jax.random.key(args.seed), image_size=size)
+
+    imgs, labels = synthetic_images(10 * args.batch_size, size, config.num_classes, args.seed)
+    n_train = 8 * args.batch_size
+
+    def batches(lo, hi):
+        return [
+            {"image": imgs[i : i + args.batch_size],
+             "label": labels[i : i + args.batch_size]}
+            for i in range(lo, hi - args.batch_size + 1, args.batch_size)
+        ]
+
+    schedule = optax.cosine_decay_schedule(args.lr, decay_steps=8 * args.num_epochs)
+    model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(
+        (module, params),
+        optax.sgd(schedule, momentum=0.9),
+        DataLoaderShard(batches(0, n_train)),
+        DataLoaderShard(batches(n_train, len(imgs))),
+        OptaxSchedule(schedule),
+    )
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+
+    step = accelerator.make_train_step(image_classification_loss_fn)
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            loss = step(batch)
+            scheduler.step()
+        correct = total = 0
+        for batch in eval_dl:
+            logits = model(batch["image"])
+            g = accelerator.gather_for_metrics(
+                {"preds": jnp.argmax(logits, axis=-1), "labels": batch["label"]}
+            )
+            correct += int((np.asarray(g["preds"]) == np.asarray(g["labels"])).sum())
+            total += len(np.asarray(g["labels"]))
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+        if args.with_tracking:
+            accelerator.log({"loss": float(loss), "accuracy": acc}, step=epoch)
+        if args.checkpointing:
+            accelerator.save_state(
+                os.path.join(accelerator.project_dir, "checkpoints", f"epoch_{epoch}")
+            )
+    accelerator.end_training()
+    return acc
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=3e-2)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--checkpointing", action="store_true")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--tiny", action="store_true")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Complete NLP example: every feature in one script (reference
+`examples/complete_nlp_example.py`) — tracking, checkpointing with epoch/step
+granularity, mid-epoch resume, gradient accumulation, clipping, LR schedule,
+and duplicate-tail-safe metric gathering. `examples/by_feature/*` each isolate
+one of these; this script is the canonical combination.
+
+Run:
+    python examples/complete_nlp_example.py --with_tracking --checkpointing_steps epoch
+    python examples/complete_nlp_example.py --resume_from_checkpoint <dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoaderShard, OptaxSchedule, set_seed, skip_first_batches
+from accelerate_tpu.accelerator import ProjectConfiguration
+from accelerate_tpu.models.bert import (
+    BertConfig,
+    BertForSequenceClassification,
+    classification_loss_fn,
+)
+
+MAX_LEN = 64
+
+
+def get_dataloaders(batch_size: int, vocab: int, seed: int):
+    rng = np.random.default_rng(seed)
+    n = 10 * batch_size
+    ids = rng.integers(10, vocab, size=(n, MAX_LEN)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    ids[labels == 1, :8] = np.arange(2, 10)
+    mask = np.ones((n, MAX_LEN), dtype=np.int32)
+    n_train = 8 * batch_size
+
+    def batches(lo, hi):
+        return [
+            {"input_ids": ids[i : i + batch_size], "attention_mask": mask[i : i + batch_size],
+             "labels": labels[i : i + batch_size]}
+            for i in range(lo, hi - batch_size + 1, batch_size)
+        ]
+
+    return batches(0, n_train), batches(n_train, n)
+
+
+def training_function(args: argparse.Namespace) -> float:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir or "complete_nlp_out",
+            automatic_checkpoint_naming=True,
+            total_limit=2,
+        ),
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config=vars(args))
+    set_seed(args.seed)
+
+    config = BertConfig.tiny() if args.tiny else BertConfig.base()
+    module = BertForSequenceClassification(config)
+    params = module.init_params(jax.random.key(args.seed))
+
+    train_batches, eval_batches = get_dataloaders(args.batch_size, config.vocab_size, args.seed)
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, warmup_steps=4, decay_steps=len(train_batches) * args.num_epochs
+    )
+    model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(
+        (module, params),
+        optax.adamw(schedule),
+        DataLoaderShard(train_batches),
+        DataLoaderShard(eval_batches),
+        OptaxSchedule(schedule),
+    )
+    accelerator.register_for_checkpointing(scheduler)
+
+    overall_step = 0
+    starting_epoch = 0
+    resume_step = None
+    if args.resume_from_checkpoint:
+        accelerator.load_state(args.resume_from_checkpoint)
+        # checkpoint name encodes the position: epoch_<e> or step_<s>
+        tag = os.path.basename(args.resume_from_checkpoint.rstrip("/"))
+        if tag.startswith("epoch_"):
+            starting_epoch = int(tag.split("_")[1]) + 1
+        elif tag.startswith("step_"):
+            overall_step = int(tag.split("_")[1])
+            starting_epoch = overall_step // len(train_dl)
+            resume_step = overall_step % len(train_dl)
+
+    step = accelerator.make_train_step(classification_loss_fn, max_grad_norm=args.max_grad_norm)
+    for epoch in range(starting_epoch, args.num_epochs):
+        dl = train_dl
+        if resume_step is not None and epoch == starting_epoch:
+            dl = skip_first_batches(train_dl, resume_step)
+            resume_step = None
+        for batch in dl:
+            loss = step(batch)
+            scheduler.step()
+            overall_step += 1
+            if args.checkpointing_steps == "step" and overall_step % args.save_every == 0:
+                accelerator.save_state(
+                    os.path.join(accelerator.project_dir, "checkpoints", f"step_{overall_step}")
+                )
+        correct = total = 0
+        for batch in eval_dl:
+            logits = model(batch["input_ids"], batch["attention_mask"])
+            g = accelerator.gather_for_metrics(
+                {"preds": jnp.argmax(logits, axis=-1), "labels": batch["labels"]}
+            )
+            correct += int((np.asarray(g["preds"]) == np.asarray(g["labels"])).sum())
+            total += len(np.asarray(g["labels"]))
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+        if args.with_tracking:
+            accelerator.log({"loss": float(loss), "accuracy": acc, "epoch": epoch},
+                            step=overall_step)
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(
+                os.path.join(accelerator.project_dir, "checkpoints", f"epoch_{epoch}")
+            )
+    accelerator.end_training()
+    return acc
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=5e-4)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--max_grad_norm", type=float, default=1.0)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--checkpointing_steps", default=None, choices=[None, "epoch", "step"])
+    parser.add_argument("--save_every", type=int, default=10, help="steps between step-checkpoints")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--tiny", action="store_true")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

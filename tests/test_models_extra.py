@@ -98,6 +98,8 @@ def test_resnet_trains():
 @pytest.mark.parametrize("script,extra", [
     ("examples/nlp_example.py", ["--with_tracking", "--checkpointing"]),
     ("examples/cv_example.py", []),
+    ("examples/complete_nlp_example.py", ["--with_tracking", "--checkpointing_steps", "epoch"]),
+    ("examples/complete_cv_example.py", ["--with_tracking", "--checkpointing"]),
 ])
 def test_example_scripts_run(tmp_path, script, extra):
     env = dict(os.environ)
@@ -110,7 +112,7 @@ def test_example_scripts_run(tmp_path, script, extra):
     cmd = [sys.executable, str(REPO / script), "--tiny", "--num_epochs", "1",
            "--project_dir", str(tmp_path)]
     cmd += [e for e in extra]
-    if "cv_example" in script:
+    if script.endswith("/cv_example.py"):
         cmd = [c for c in cmd if c not in ("--project_dir", str(tmp_path))]
     out = subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=600)
     assert out.returncode == 0, out.stdout + out.stderr
