@@ -1,0 +1,62 @@
+"""gather_for_metrics correctness on N processes with a ragged final batch
+(reference `test_utils/scripts/external_deps/test_metrics.py` — distributed
+metric must equal the single-process truth, duplicated tail dropped).
+
+Uses the canonical path: a torch DataLoader over the full dataset, sharded by
+`prepare_data_loader` (BatchSamplerShard owns the even-batches padding math, so
+the duplicates land at the global tail where gather_for_metrics drops them)."""
+
+
+def run_checks():
+    import numpy as np
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    state = PartialState()
+
+    import torch.utils.data as tud
+
+    # 22 samples, per-process batch 8 -> ragged tail; with even_batches the
+    # wrapped duplicates sit at the global end and must be dropped
+    n = 22
+    rng = np.random.default_rng(1)
+    preds = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    truth = float((preds == labels).mean())
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"preds": preds[i], "labels": labels[i], "idx": np.int32(i)}
+
+    loader = tud.DataLoader(DS(), batch_size=8, shuffle=False)
+    acc = Accelerator()
+    dl = acc.prepare_data_loader(loader)
+
+    got = {"preds": [], "labels": [], "idx": []}
+    for b in dl:
+        g = acc.gather_for_metrics({k: b[k] for k in got})
+        for k in got:
+            got[k].append(np.asarray(g[k]))
+    got = {k: np.concatenate(v) for k, v in got.items()}
+    assert len(got["preds"]) == n, (len(got["preds"]), n)
+    # every sample exactly once (order may be resharded, so compare by index)
+    np.testing.assert_array_equal(np.sort(got["idx"]), np.arange(n))
+    order = np.argsort(got["idx"])
+    np.testing.assert_array_equal(got["preds"][order], preds)
+    np.testing.assert_array_equal(got["labels"][order], labels)
+    assert abs(float((got["preds"] == got["labels"]).mean()) - truth) < 1e-9
+    state.wait_for_everyone()
+    print(f"proc {state.process_index}: gather_for_metrics OK", flush=True)
+
+
+if __name__ == "__main__":
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks()

@@ -1,0 +1,68 @@
+"""Gradient-accumulation semantics assertions, run on N JAX processes under the
+debug launcher (reference `test_utils/scripts/test_sync.py` — no_sync /
+accumulate equivalence and optimizer-step gating)."""
+
+
+def run_checks():
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    state = PartialState()
+
+    rng = np.random.default_rng(0)
+    batches = [
+        {"x": rng.normal(size=(4,)).astype(np.float32),
+         "y": rng.normal(size=(4,)).astype(np.float32)}
+        for _ in range(4)
+    ]
+
+    def apply_fn(p, x):
+        return p["a"] * x + p["b"]
+
+    def loss_fn(m, batch):
+        return ((m(batch["x"]) - batch["y"]) ** 2).mean()
+
+    params = {"a": np.zeros((1,), np.float32), "b": np.zeros((1,), np.float32)}
+    lr = 0.1
+
+    acc = Accelerator(gradient_accumulation_steps=2)
+    model, opt, dl = acc.prepare((apply_fn, dict(params)), optax.sgd(lr), DataLoaderShard(batches))
+    step = acc.make_train_step(loss_fn)
+    sync_flags = []
+    for batch in dl:
+        step(batch)
+        sync_flags.append(acc.gradient_state.sync_gradients)
+    # 4 microbatches / accumulation 2 -> updates on batches 1 and 3 only
+    assert opt._num_updates == 2, opt._num_updates
+    assert sync_flags == [False, True, False, True], sync_flags
+
+    # hand-computed baseline: mean of the two microbatch grads, two SGD steps
+    ref = {k: np.asarray(v, np.float64) for k, v in params.items()}
+    for pair in (batches[0:2], batches[2:4]):
+        ga = gb = 0.0
+        for b in pair:
+            pred = ref["a"] * b["x"] + ref["b"]
+            err = pred - b["y"]
+            ga += (2 * err * b["x"]).mean() / 2  # /2: accumulation average
+            gb += (2 * err).mean() / 2
+        ref["a"] = ref["a"] - lr * ga
+        ref["b"] = ref["b"] - lr * gb
+    got = jax.tree.map(np.asarray, acc.get_state_dict(model))
+    np.testing.assert_allclose(got["a"], ref["a"], rtol=1e-5)
+    np.testing.assert_allclose(got["b"], ref["b"], rtol=1e-5)
+    state.wait_for_everyone()
+    print(f"proc {state.process_index}: accumulation semantics OK", flush=True)
+
+
+if __name__ == "__main__":
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks()

@@ -115,3 +115,35 @@ def test_multiprocess_ops_script():
     finally:
         os.environ.clear()
         os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_sync_script():
+    """Tier-2: accumulation/no_sync semantics on 2 real JAX processes
+    (reference test_sync.py role)."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_sync
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_sync.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_metrics_script():
+    """Tier-2: gather_for_metrics ragged-tail correctness on 2 real JAX
+    processes (reference test_metrics.py role)."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_metrics
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_metrics.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
