@@ -1,0 +1,1 @@
+from .platform import force_cpu_platform  # noqa: F401

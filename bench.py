@@ -15,41 +15,86 @@ Env knobs (all optional):
   BENCH_TIMEOUT     watchdog seconds (default 540): if the device never
                     responds (e.g. dead TPU tunnel), print an error JSON line
                     and exit instead of hanging the driver.
+  BENCH_PROBE_TIMEOUT  seconds for the subprocess device-reachability probe
+                    (default 180); on timeout an {"error": "tpu-unreachable"}
+                    JSON line is printed instead of hanging at startup.
+  BENCH_FORCE_CPU=1 skip the probe and run on the host-CPU platform (CI use).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import threading
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
+def _probe_devices(timeout: int) -> tuple[str | None, str | None]:
+    """Check device reachability in a *subprocess* before importing jax here.
+
+    The environment's sitecustomize registers the axon TPU plugin in every
+    Python process; with the relay down, ``jax.devices()`` blocks forever and a
+    working framework scores 0.0. Probing in a child process with a hard
+    timeout turns an infra outage into a distinguishable error JSON.
+    Returns ``(platform, None)`` on success, ``(None, why)`` on failure —
+    distinguishing a hang (unreachable) from a crash (probe-failed + stderr).
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "x = jax.numpy.ones(8) + 1; x.block_until_ready(); "
+             "print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, (f"jax.devices() did not answer within {timeout}s "
+                      "(axon relay down?); not a performance result")
+    if out.returncode != 0:
+        return None, (f"device probe crashed rc={out.returncode}: "
+                      + out.stderr.strip()[-500:])
+    if not out.stdout.strip():
+        return None, "device probe produced no output"
+    return out.stdout.strip().splitlines()[-1], None
+
+
+def _emit_probe_failure(why: str) -> None:
+    kind = "tpu-unreachable" if "did not answer" in why else "probe-failed"
+    _emit(0.0, 0.0, {"error": kind, "probe": why}, error=kind)
+
+
+def _emit(value: float, vs_baseline: float, detail: dict, **extra) -> None:
+    """The ONE JSON line the driver records; every exit path goes through here."""
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_train_tokens_per_sec_per_chip",
+                "value": value,
+                "unit": "tokens/s/chip",
+                "vs_baseline": vs_baseline,
+                **extra,
+                "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+
+
+
+
 def _arm_watchdog(seconds: int, state: dict) -> None:
     def fire():
         if state.get("done"):
             return
-        print(
-            json.dumps(
-                {
-                    "metric": "gpt2_train_tokens_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "tokens/s/chip",
-                    "vs_baseline": 0.0,
-                    "detail": {"error": f"watchdog: device unresponsive after {seconds}s",
-                               "stage": state.get("stage", "startup")},
-                }
-            ),
-            flush=True,
-        )
+        _emit(0.0, 0.0, {"error": f"watchdog: device unresponsive after {seconds}s",
+                         "stage": state.get("stage", "startup")},
+              error="device-watchdog")
         os._exit(2)
 
     t = threading.Timer(seconds, fire)
@@ -58,9 +103,25 @@ def _arm_watchdog(seconds: int, state: dict) -> None:
 
 
 def main() -> None:
+    force_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
+    if not force_cpu:
+        platform, why = _probe_devices(_env_int("BENCH_PROBE_TIMEOUT", 180))
+        if platform is None:
+            _emit_probe_failure(why)
+            sys.exit(0)
+
     state = {"done": False, "stage": "startup"}
     _arm_watchdog(_env_int("BENCH_TIMEOUT", 540), state)
 
+    import jax
+
+    if force_cpu:
+        from accelerate_tpu.test_utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+
+    import jax.numpy as jnp
+    import numpy as np
     import optax
 
     from accelerate_tpu.accelerator import Accelerator
@@ -129,26 +190,20 @@ def main() -> None:
     peak_flops = 394e12 if on_tpu else 1e12  # v5e bf16 peak per chip
     mfu = achieved_flops / peak_flops
 
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec_chip, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(mfu / 0.40, 4),
-                "detail": {
-                    "mfu": round(mfu, 4),
-                    "model": "gpt2-small" if on_tpu else "gpt2-tiny(cpu)",
-                    "batch": batch,
-                    "seq": seq,
-                    "attn": attn,
-                    "scan": scan,
-                    "remat": remat or "off",
-                    "platform": jax.devices()[0].platform,
-                    "loss": round(final_loss, 4),
-                },
-            }
-        )
+    _emit(
+        round(tokens_per_sec_chip, 1),
+        round(mfu / 0.40, 4),
+        {
+            "mfu": round(mfu, 4),
+            "model": "gpt2-small" if on_tpu else "gpt2-tiny(cpu)",
+            "batch": batch,
+            "seq": seq,
+            "attn": attn,
+            "scan": scan,
+            "remat": remat or "off",
+            "platform": jax.devices()[0].platform,
+            "loss": round(final_loss, 4),
+        },
     )
 
 

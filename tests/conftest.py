@@ -2,21 +2,13 @@
 runs without TPU hardware (the reference's "multi-node without a cluster" tier —
 SURVEY.md §4 tier 3 — realized natively via XLA host-platform device multiplexing).
 
-Must run before any jax import, hence module-level os.environ mutation in conftest.
+The one audited CPU-forcing defense lives in accelerate_tpu.test_utils.platform;
+it must run before any JAX backend initialization, hence module level.
 """
 
-import os
+from accelerate_tpu.test_utils.platform import force_cpu_platform
 
-# jax may already be imported by a sitecustomize that registers a TPU plugin, so
-# env vars alone are not enough: XLA_FLAGS must be set before the CPU client
-# initializes, and the platform override must go through jax.config.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 
