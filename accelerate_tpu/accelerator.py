@@ -753,12 +753,25 @@ class Accelerator:
                 check_vma=False,
             )
 
+        # Pin gradients and updated params to the params' own shardings so the
+        # whole fused step (grad -> clip -> optax update -> apply) carries ONE
+        # consistent spec per leaf. Without this XLA is free to re-infer specs
+        # in the backward, which on dp×fsdp×tp meshes produced involuntary full
+        # rematerialization (VERDICT r1: spmd_partitioner warnings).
+        param_shardings = getattr(model, "shardings", None)
+
+        def constrain_like_params(tree):
+            if param_shardings is None or tree is None:
+                return tree
+            return jax.tree.map(jax.lax.with_sharding_constraint, tree, param_shardings)
+
         def make_micro(lgr):
             @jax.jit
             def micro_step(params, mstate, acc, batch, comm_rep, comm_err):
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err
                 )
+                grads = constrain_like_params(grads)
                 acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
                 return acc, mstate, loss * k, comm_rep, comm_err
 
@@ -771,10 +784,11 @@ class Accelerator:
                 )
                 if acc is not None:
                     grads = jax.tree.map(jnp.add, acc, grads)
+                grads = constrain_like_params(grads)
                 if max_grad_norm is not None:
                     grads, _ = _clip_tree(grads, max_grad_norm)
                 updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                params = constrain_like_params(optax.apply_updates(params, updates))
                 return params, opt_state, mstate, loss * k, comm_rep, comm_err
 
             return jax.jit(_update, donate_argnums=(0, 1, 2, 3, 6) if donate else ())

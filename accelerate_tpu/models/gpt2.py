@@ -217,7 +217,12 @@ def gpt2_sharding_rules() -> ShardingRules:
             (r".*attn/proj/kernel", P("tensor", None)),
             (r".*mlp/up/kernel", P(None, "tensor")),
             (r".*mlp/down/kernel", P("tensor", None)),
-            (r".*wte", P("tensor", None)),
+            # vocab dim over tensor AND fsdp, embed dim replicated: folding fsdp
+            # into the embed dim makes the wte-grad scatter reshard the whole
+            # (batch, seq, embed) activation gradient into a transposed layout
+            # (involuntary full remat); vocab-only sharding needs just the
+            # token indices replicated, which they already are.
+            (r".*wte", P(("tensor", "fsdp"), None)),
             (r".*wpe", P(None, None)),
             (r".*(qkv|up)/bias", P("tensor")),
         ]

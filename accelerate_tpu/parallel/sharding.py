@@ -78,7 +78,14 @@ class ShardingRules:
 
 def _fsdp_spec(shape: tuple[int, ...], existing: PartitionSpec | None, fsdp_size: int) -> PartitionSpec:
     """Add ``fsdp`` sharding on the largest dim divisible by the axis size that is
-    not already sharded; replicate scalars/indivisible leaves."""
+    not already sharded; replicate scalars/indivisible leaves.
+
+    1-D leaves (biases, layernorm scales) are deliberately left replicated:
+    sharding a vector the size of the embedding dim saves nothing but makes XLA
+    propagate an embedding-dim sharding onto the (batch, seq, embed) activation
+    gradients in the backward, which conflicts with their batch sharding and
+    triggers involuntary full rematerialization (spmd_partitioner warnings).
+    """
     used = set()
     parts: list = list(existing) if existing is not None else [None] * len(shape)
     while len(parts) < len(shape):
@@ -88,7 +95,7 @@ def _fsdp_spec(shape: tuple[int, ...], existing: PartitionSpec | None, fsdp_size
             continue
         for name in (p if isinstance(p, tuple) else (p,)):
             used.add(name)
-    if "fsdp" in used or fsdp_size <= 1:
+    if "fsdp" in used or fsdp_size <= 1 or len(shape) < 2:
         return PartitionSpec(*parts)
     candidates = [
         (shape[i], i)
