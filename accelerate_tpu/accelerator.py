@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import weakref
 from collections.abc import Mapping
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable
@@ -279,7 +280,8 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
         self._custom_objects: list[Any] = []
-        self._grad_fns: dict[tuple, Callable] = {}
+        # model -> (loss_fn -> jitted grad fn), both levels weakly keyed
+        self._grad_fns: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self._train_steps: dict[tuple, Any] = {}
         self.trackers: list = []
         self._log_with = log_with
@@ -549,9 +551,18 @@ class Accelerator:
         yield
 
     def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel) -> Callable:
-        key = (id(loss_fn), id(model))
-        if key in self._grad_fns:
-            return self._grad_fns[key]
+        # Keyed on live object identity via weak references: an id()-keyed dict
+        # can silently hand a new function a dead function's compiled program
+        # after GC reuses the address.
+        per_model = self._grad_fns.get(model)
+        if per_model is None:
+            per_model = self._grad_fns[model] = weakref.WeakKeyDictionary()
+        try:
+            cached = per_model.get(loss_fn)
+        except TypeError:  # unhashable loss_fn
+            cached = None
+        if cached is not None:
+            return cached
         policy = self.policy
 
         def compute(params, mstate, batch, scale):
@@ -568,7 +579,10 @@ class Accelerator:
             return convert_to_fp32(loss), aux, grads, new_mstate
 
         fn = jax.jit(compute)
-        self._grad_fns[key] = fn
+        try:
+            per_model[loss_fn] = fn
+        except TypeError:
+            pass  # not weakref-able (e.g. a builtin): recompile next call
         return fn
 
     def backward(self, loss_fn: Callable, batch: Any = None, model: PreparedModel | None = None, **kwargs: Any):
@@ -611,30 +625,35 @@ class Accelerator:
 
     def unscale_gradients(self, optimizer: AcceleratedOptimizer | None = None) -> None:
         """Explicit fp16 unscale (reference `accelerator.py:2293-2325`); normally
-        `optimizer.step()` does this itself."""
+        `optimizer.step()` does this itself. Idempotent within one boundary —
+        the optimizer's next real step clears the unscaled mark."""
         opts = [optimizer] if optimizer is not None else self._optimizers
         for opt in opts:
-            if opt.scaler is not None and opt._acc_grads is not None:
+            if opt.scaler is not None and opt._acc_grads is not None and not opt._unscaled:
                 grads, opt.scaler_state, finite = opt.scaler.unscale_and_update(
                     opt._acc_grads, opt.scaler_state
                 )
                 opt._acc_grads = grads
                 opt.step_was_skipped = not bool(finite)
-                opt.scaler = None  # mark unscaled for this boundary
+                opt._unscaled = True
 
     def clip_grad_norm_(self, parameters: Any = None, max_norm: float = 1.0, norm_type: float = 2.0):
         """Clip accumulated gradients by global norm, returning the pre-clip norm
-        (reference `accelerator.py:2327-2382`). Runs jitted over the sharded grad
-        pytree — the cross-device reduction is XLA's, no hand-rolled allreduce."""
+        (reference `accelerator.py:2327-2382`). Unscales fp16 gradients first
+        (reference behavior), computes ONE norm over every prepared optimizer's
+        gradients together, and scales them all by the same factor. Runs jitted
+        over the sharded grad pytrees — the cross-device reduction is XLA's."""
         if norm_type != 2.0:
             raise NotImplementedError("Only L2 global-norm clipping is supported.")
-        total_norm = None
-        for opt in self._optimizers:
-            if opt._acc_grads is None:
-                continue
-            clipped, norm = _clip_by_global_norm(opt._acc_grads, max_norm)
-            opt._acc_grads = clipped
-            total_norm = norm
+        self.unscale_gradients()
+        with_grads = [opt for opt in self._optimizers if opt._acc_grads is not None]
+        if not with_grads:
+            return None
+        clipped, total_norm = _clip_tree(
+            tuple(opt._acc_grads for opt in with_grads), max_norm
+        )
+        for opt, tree in zip(with_grads, clipped):
+            opt._acc_grads = tree
         return total_norm
 
     def clip_grad_value_(self, parameters: Any = None, clip_value: float = 1.0) -> None:

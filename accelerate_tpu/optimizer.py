@@ -46,6 +46,7 @@ class AcceleratedOptimizer:
         self._step_fn: Callable | None = None
         self._accumulate_fn: Callable | None = None
         self.step_was_skipped = False
+        self._unscaled = False  # grads already unscaled this boundary
         self._num_updates = 0
         if model is not None:
             self._init_state()
@@ -114,7 +115,16 @@ class AcceleratedOptimizer:
         self._ensure_jits()
         grads = self._acc_grads
         if self.scaler is not None:
-            grads, self.scaler_state, finite = self.scaler.unscale_and_update(grads, self.scaler_state)
+            if self._unscaled:
+                # explicit accelerator.unscale_gradients() already ran this
+                # boundary (it set step_was_skipped on overflow); don't divide
+                # by the scale a second time
+                finite = not self.step_was_skipped
+            else:
+                grads, self.scaler_state, finite = self.scaler.unscale_and_update(
+                    grads, self.scaler_state
+                )
+            self._unscaled = False
             if not bool(finite):
                 self.step_was_skipped = True
                 self._acc_grads = None

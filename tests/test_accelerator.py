@@ -247,6 +247,77 @@ class TestAcceleratorBasics:
         assert opt.step_was_skipped
         np.testing.assert_array_equal(np.asarray(model.params["a"]), before)
 
+    def test_fp16_explicit_unscale_clip_step_boundaries(self):
+        """unscale -> clip -> step over several boundaries, incl. an overflow
+        skip and recovery: the scaler must survive an explicit unscale boundary
+        (round-1 bug: it was set to None and every later step ran unscaled)."""
+        acc = _fresh_accelerator(mixed_precision="fp16")
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.05))
+        batches = make_regression_batches(4, 16)
+        assert opt.scaler is not None
+        scale0 = float(opt.scaler_state.scale)
+        for i, batch in enumerate(batches):
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            acc.backward(regression_loss_fn, batch)
+            if i == 1:  # inject an overflow mid-training
+                opt._acc_grads = jax.tree.map(
+                    lambda g: jnp.full_like(g, jnp.inf), opt._acc_grads
+                )
+            acc.unscale_gradients()
+            acc.clip_grad_norm_(max_norm=1.0)  # second unscale must be a no-op
+            before = np.asarray(model.params["a"]).copy()
+            opt.step()
+            opt.zero_grad()
+            assert opt.scaler is not None, "scaler lost after explicit unscale"
+            if i == 1:
+                assert opt.step_was_skipped
+                np.testing.assert_array_equal(np.asarray(model.params["a"]), before)
+                # overflow halves the scale
+                assert float(opt.scaler_state.scale) == pytest.approx(scale0 / 2)
+            else:
+                assert not opt.step_was_skipped
+                assert np.any(np.asarray(model.params["a"]) != before)
+        # post-clip gradients were bounded by max_norm on every applied step
+        # and training recovered after the skipped boundary
+        assert opt.num_updates == len(batches) - 1
+
+    def test_clip_grad_norm_combined_across_optimizers(self):
+        """With two prepared model/optimizer pairs the returned norm is the
+        combined global norm, and both grad trees are scaled by one factor
+        (round-1 bug: only the last optimizer's norm was returned)."""
+        acc = _fresh_accelerator()
+        m1, o1 = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        m2, o2 = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        o1.accumulate_grads({"a": jnp.asarray([3.0]), "b": jnp.asarray([0.0])})
+        o2.accumulate_grads({"a": jnp.asarray([4.0]), "b": jnp.asarray([0.0])})
+        norm = acc.clip_grad_norm_(max_norm=1.0)
+        assert float(norm) == pytest.approx(5.0)  # sqrt(3^2 + 4^2)
+        np.testing.assert_allclose(np.asarray(o1._acc_grads["a"]), [3.0 / 5.0], rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(o2._acc_grads["a"]), [4.0 / 5.0], rtol=2e-5)
+
+    def test_grad_fn_cache_weakly_keyed(self):
+        """Dropping all references to a loss_fn must evict its cache entry so a
+        new function at a recycled id() can never reuse the stale program."""
+        acc = _fresh_accelerator()
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        batch = {k: jnp.asarray(v) for k, v in make_regression_batches(1, 16)[0].items()}
+
+        def make_loss(scale):
+            def loss(m, b):
+                return regression_loss_fn(m, b) * scale
+
+            return loss
+
+        fn = make_loss(1.0)
+        acc.backward(fn, batch, model=model)
+        per_model = acc._grad_fns[model]
+        assert len(per_model) == 1
+        del fn
+        import gc
+
+        gc.collect()
+        assert len(per_model) == 0
+
     def test_scheduler_steps_only_on_sync(self):
         from accelerate_tpu.scheduler import OptaxSchedule
 
