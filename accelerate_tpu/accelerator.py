@@ -553,36 +553,50 @@ class Accelerator:
     def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel) -> Callable:
         # Keyed on live object identity via weak references: an id()-keyed dict
         # can silently hand a new function a dead function's compiled program
-        # after GC reuses the address.
+        # after GC reuses the address. The cached value must NOT strongly
+        # reference loss_fn (the key) — a value→key edge would pin the entry
+        # forever — so `compute` closes over a weakref and the dict entry is
+        # evicted by the weakref callback when loss_fn dies.
         per_model = self._grad_fns.get(model)
         if per_model is None:
-            per_model = self._grad_fns[model] = weakref.WeakKeyDictionary()
+            per_model = self._grad_fns[model] = {}
         try:
-            cached = per_model.get(loss_fn)
-        except TypeError:  # unhashable loss_fn
-            cached = None
+            probe = weakref.ref(loss_fn)
+            cached = per_model.get(probe)  # hashes the referent — may also raise
+        except TypeError:  # not weakref-able or not hashable: recompile each call
+            probe, cached = None, None
         if cached is not None:
             return cached
         policy = self.policy
+        apply_fn = model.apply_fn
+        loss_ref = probe if probe is not None else (lambda fn=loss_fn: fn)
 
-        def compute(params, mstate, batch, scale):
-            def scaled_loss(p):
-                bound = BoundModel(model.apply_fn, policy.cast_to_compute(p), mstate)
-                out = loss_fn(bound, batch)
+        def compute(params, mstate, batch, inner_scale, outer_scale):
+            live_loss_fn = loss_ref()
+            if live_loss_fn is None:  # pragma: no cover - entry evicted before call
+                raise RuntimeError("loss_fn was garbage-collected before the step ran")
+
+            def fwd(p):
+                bound = BoundModel(apply_fn, policy.cast_to_compute(p), mstate)
+                out = live_loss_fn(bound, batch)
                 if isinstance(out, tuple):
                     loss, aux = out[0], out[1:]
                 else:
                     loss, aux = out, ()
-                return (loss.astype(jnp.float32) * scale, (loss, aux, bound.extra_state))
+                # inner_scale rides INSIDE the reduced-precision backward (fp16
+                # underflow protection, capped fp16-safe so a healthy cotangent
+                # chain can't trip 65504); the outer remainder is applied to
+                # the fp32 grads below. See DynamicGradScaler.split_scale.
+                return (loss.astype(jnp.float32) * inner_scale, (loss, aux, bound.extra_state))
 
-            (_, (loss, aux, new_mstate)), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+            (_, (loss, aux, new_mstate)), grads = jax.value_and_grad(fwd, has_aux=True)(params)
+            grads = jax.tree.map(lambda g: g * outer_scale, grads)
             return convert_to_fp32(loss), aux, grads, new_mstate
 
         fn = jax.jit(compute)
-        try:
-            per_model[loss_fn] = fn
-        except TypeError:
-            pass  # not weakref-able (e.g. a builtin): recompile next call
+        if probe is not None:
+            key = weakref.ref(loss_fn, lambda ref, d=per_model: d.pop(ref, None))
+            per_model[key] = fn
         return fn
 
     def backward(self, loss_fn: Callable, batch: Any = None, model: PreparedModel | None = None, **kwargs: Any):
@@ -590,21 +604,26 @@ class Accelerator:
 
         The reference's ``accelerator.backward(loss)`` rides torch's implicit
         tape; JAX has no tape, so the facade takes the loss *function* and returns
-        the loss value. Loss is scaled by 1/gradient_accumulation_steps (reference
-        `accelerator.py:2199-2231`) and by the dynamic fp16 scale when active.
+        the loss value. Gradients are scaled by 1/gradient_accumulation_steps
+        (reference `accelerator.py:2199-2231`) and by the dynamic fp16 scale when
+        active — applied to the fp32 grads after the backward, so the scaler's
+        multiplier can never itself overflow the fp16 cotangent chain.
         """
         if model is None:
             if len(self._models) != 1:
                 raise ValueError("backward() needs `model=` when zero or multiple models are prepared.")
             model = self._models[0]
         grad_fn = self._get_grad_fn(loss_fn, model)
-        scale = 1.0 / self.gradient_state.num_steps
+        inv_k = 1.0 / self.gradient_state.num_steps
+        inner = jnp.asarray(1.0, dtype=jnp.float32)
+        outer = jnp.asarray(inv_k, dtype=jnp.float32)
         if self.scaler is not None:
             opt = self._optimizer_for(model)
             if opt is not None and opt.scaler_state is not None:
-                scale = opt.scaler_state.scale * scale
+                inner, rest = self.scaler.split_scale(opt.scaler_state.scale)
+                outer = rest * inv_k
         loss, aux, grads, new_mstate = grad_fn(
-            model.params, model.extra_state, batch, jnp.asarray(scale, dtype=jnp.float32)
+            model.params, model.extra_state, batch, inner, outer
         )
         model.extra_state = new_mstate
         opt = self._optimizer_for(model)
@@ -695,7 +714,10 @@ class Accelerator:
             optimizer = self._optimizer_for(model)
         policy = self.policy
         tx = optimizer.optimizer
-        k = self.gradient_state.num_steps
+        # NOTE: gradient_accumulation_steps is read LIVE from gradient_state at
+        # every boundary (as a traced scalar, so changing it never recompiles) —
+        # freezing it at build time silently mis-scaled the loss if the user
+        # changed it after building the step.
 
         hook_cfg = None
         if comm_hook is not None:
@@ -727,7 +749,7 @@ class Accelerator:
                 bound = BoundModel(model.apply_fn, policy.cast_to_compute(p), mstate)
                 out = loss_fn(bound, batch)
                 loss = out[0] if isinstance(out, tuple) else out
-                return loss.astype(jnp.float32) / k, bound.extra_state
+                return loss.astype(jnp.float32), bound.extra_state
 
             (loss, new_mstate), grads = jax.value_and_grad(f, has_aux=True)(params)
             return loss, grads, new_mstate
@@ -792,23 +814,24 @@ class Accelerator:
                 )
                 grads = constrain_like_params(grads)
                 acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
-                return acc, mstate, loss * k, comm_rep, comm_err
+                return acc, mstate, loss, comm_rep, comm_err
 
             return micro_step
 
         def make_update(lgr):
-            def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err):
+            def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err, inv_k):
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err
                 )
                 if acc is not None:
                     grads = jax.tree.map(jnp.add, acc, grads)
+                grads = jax.tree.map(lambda g: g * inv_k, grads)
                 grads = constrain_like_params(grads)
                 if max_grad_norm is not None:
                     grads, _ = _clip_tree(grads, max_grad_norm)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = constrain_like_params(optax.apply_updates(params, updates))
-                return params, opt_state, mstate, loss * k, comm_rep, comm_err
+                return params, opt_state, mstate, loss, comm_rep, comm_err
 
             return jax.jit(_update, donate_argnums=(0, 1, 2, 3, 6) if donate else ())
 
@@ -816,7 +839,9 @@ class Accelerator:
         micro_hooked = update_hooked = None
         if hook_cfg is not None:
             micro_hooked, update_hooked = make_micro(lgr_hooked), make_update(lgr_hooked)
-            comm_rep0, comm_err0 = init_comm_state(model.params, hook_cfg, n_replicas)
+            comm_rep0, comm_err0 = init_comm_state(
+                model.params, hook_cfg, n_replicas, mesh=mesh, axis="data"
+            )
         else:
             comm_rep0 = comm_err0 = None
         warmup = hook_cfg.warmup_updates if hook_cfg is not None else 0
@@ -827,6 +852,7 @@ class Accelerator:
             hooked = hook_cfg is not None and optimizer._num_updates >= warmup
             if self.gradient_state.sync_gradients:
                 upd = update_hooked if hooked else update_plain
+                inv_k = jnp.asarray(1.0 / self.gradient_state.num_steps, dtype=jnp.float32)
                 params, opt_state, mstate, loss, state_box["rep"], state_box["err"] = upd(
                     model.params,
                     optimizer.opt_state,
@@ -835,6 +861,7 @@ class Accelerator:
                     batch,
                     state_box["rep"],
                     state_box["err"],
+                    inv_k,
                 )
                 model.params = params
                 optimizer.opt_state = opt_state

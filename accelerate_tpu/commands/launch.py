@@ -67,7 +67,18 @@ def _run_script(script: str, script_args: list[str], module: bool) -> None:
         runpy.run_path(script, run_name="__main__")
 
 
-def _debug_cpu_launch(n: int, script: str, script_args: list[str], base_env: dict[str, str]) -> int:
+def _child_command(script: str, script_args: list[str], module: bool) -> list[str]:
+    """The argv for a child process running the user script — honoring
+    ``--module`` the same way the in-process path does (reference
+    `utils/launch.py` builds `[sys.executable, "-m", ...]` likewise)."""
+    if module:
+        return [sys.executable, "-m", script, *script_args]
+    return [sys.executable, script, *script_args]
+
+
+def _debug_cpu_launch(
+    n: int, script: str, script_args: list[str], base_env: dict[str, str], module: bool = False
+) -> int:
     """Fork n local JAX processes over a localhost coordinator (CPU platform)."""
     import socket
 
@@ -88,7 +99,7 @@ def _debug_cpu_launch(n: int, script: str, script_args: list[str], base_env: dic
                 "ACCELERATE_TPU_NUM_PROCESSES": str(n),
             }
         )
-        procs.append(subprocess.Popen([sys.executable, script, *script_args], env=env))
+        procs.append(subprocess.Popen(_child_command(script, script_args, module), env=env))
     rc = 0
     for p in procs:
         rc = p.wait() or rc
@@ -101,6 +112,7 @@ def _supervised_launch(
     base_env: dict[str, str],
     max_restarts: int,
     monitor_interval: float,
+    module: bool = False,
 ) -> int:
     """Failure-detecting supervisor: run the script as a child process and
     restart it on nonzero exit, up to ``max_restarts`` times.
@@ -120,7 +132,7 @@ def _supervised_launch(
         env = dict(os.environ)
         env.update(base_env)
         env["ACCELERATE_TPU_RESTART_COUNT"] = str(restarts)
-        proc = subprocess.Popen([sys.executable, script, *script_args], env=env)
+        proc = subprocess.Popen(_child_command(script, script_args, module), env=env)
         while proc.poll() is None:
             time.sleep(monitor_interval)
         rc = proc.returncode
@@ -164,7 +176,10 @@ def launch_command(args: argparse.Namespace) -> None:
 
     env = launch_env(cfg)
     if args.debug_cpu:
-        rc = _debug_cpu_launch(args.debug_cpu, args.training_script, args.training_script_args, env)
+        rc = _debug_cpu_launch(
+            args.debug_cpu, args.training_script, args.training_script_args, env,
+            module=args.module,
+        )
         sys.exit(rc)
     if args.max_restarts:
         rc = _supervised_launch(
@@ -173,6 +188,7 @@ def launch_command(args: argparse.Namespace) -> None:
             env,
             max_restarts=args.max_restarts,
             monitor_interval=args.monitor_interval,
+            module=args.module,
         )
         sys.exit(rc)
     os.environ.update(env)

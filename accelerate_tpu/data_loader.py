@@ -34,7 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .state import AcceleratorState, GradientState, PartialState
 from .parallel.mesh import data_axes
-from .utils.operations import recursively_apply, broadcast_object_list
+from .utils.operations import as_registered_pytree, recursively_apply, broadcast_object_list
 from .utils.random import get_rng_key, synchronize_rng_states
 
 
@@ -368,9 +368,13 @@ class DataLoaderShard:
 
     def _to_global(self, batch: Any) -> Any:
         """numpy/torch leaves -> one global jax.Array per leaf, sharded on the
-        data axes. Pads a ragged leading dim by wrapping (static shapes for XLA)."""
+        data axes. Pads a ragged leading dim by wrapping (static shapes for XLA).
+        On the device-placement path, unregistered Mapping containers (HF
+        BatchEncoding/UserDict) are normalized to plain dicts so the batch can
+        cross the jit boundary; the host-only path keeps the user's container."""
         if not self.device_placement:
             return recursively_apply(_leaf_to_numpy, batch, test_type=_is_arraylike)
+        batch = as_registered_pytree(batch)
         sharding = self._data_sharding()
         mesh = sharding.mesh
         shards = math.prod(mesh.shape[a] for a in data_axes(mesh))

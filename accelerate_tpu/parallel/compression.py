@@ -79,14 +79,23 @@ def _compressible(shape: tuple, size: int, cfg: CommHookConfig) -> bool:
 
 
 def init_comm_state(
-    grads_shape: Any, cfg: CommHookConfig, num_replicas: int = 1, seed: int = 0
+    grads_shape: Any,
+    cfg: CommHookConfig,
+    num_replicas: int = 1,
+    seed: int = 0,
+    mesh: Any = None,
+    axis: str = "data",
 ) -> tuple[Any, Any]:
     """Build the persistent hook state for a gradient pytree (shapes only).
 
     Returns ``(replicated, per_replica)``. PowerSGD keeps, per compressible leaf:
     Q (N, r) warm-start factor + step counter (replicated) and the error-feedback
-    buffer E with shape (num_replicas, *grad_shape) (per-replica, sharded over the
-    data axis by the caller). Stateless hooks (fp16/bf16/no) get ``(None, None)``.
+    buffer E with shape (num_replicas, *grad_shape) (per-replica). When ``mesh``
+    is given the error buffers are *allocated* sharded over ``axis`` — each
+    device only ever holds its own (1, *shape) slice; the full per-replica stack
+    never exists on any single device (it is params-sized × num_replicas, i.e.
+    exactly the scale where PowerSGD is used because HBM is tight).
+    Stateless hooks (fp16/bf16/no) get ``(None, None)``.
     """
     if not cfg.is_powersgd:
         return None, None
@@ -104,14 +113,31 @@ def init_comm_state(
         q = jax.random.normal(k, (n, r), jnp.float32)
         return {"q": q, "step": jnp.zeros((), jnp.int32)}
 
-    def err_one(leaf):
-        shape = tuple(leaf.shape)
-        if not _compressible(shape, math.prod(shape), cfg):
-            return None
-        return jnp.zeros((num_replicas, *shape), jnp.float32)
-
     rep = jax.tree.unflatten(treedef, [rep_one(l, k) for l, k in zip(leaves, keys)])
-    err = jax.tree.unflatten(treedef, [err_one(l) for l in leaves])
+
+    err_shapes = [
+        tuple(l.shape) if _compressible(tuple(l.shape), math.prod(tuple(l.shape)), cfg) else None
+        for l in leaves
+    ]
+
+    def zeros_all():
+        return tuple(
+            jnp.zeros((num_replicas, *s), jnp.float32) for s in err_shapes if s is not None
+        )
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # ONE jitted program zero-fills every buffer directly in its shards —
+        # no device ever holds a full (num_replicas, *shape) copy and there is
+        # a single compile, not one per parameter tensor.
+        sharding = NamedSharding(mesh, PartitionSpec(axis))
+        n_bufs = sum(s is not None for s in err_shapes)
+        zeros = jax.jit(zeros_all, out_shardings=(sharding,) * n_bufs)()
+    else:
+        zeros = zeros_all()
+    it = iter(zeros)
+    err = jax.tree.unflatten(treedef, [next(it) if s is not None else None for s in err_shapes])
     return rep, err
 
 

@@ -40,13 +40,6 @@ def is_tensor(x: Any) -> bool:
     return isinstance(x, TensorTypes)
 
 
-def honor_type(obj: Any, generator) -> Any:
-    """Rebuild a sequence with the same container type (handles namedtuples)."""
-    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
-        return type(obj)(*list(generator))
-    return type(obj)(generator)
-
-
 def recursively_apply(
     func: Callable,
     data: Any,
@@ -55,34 +48,50 @@ def recursively_apply(
     error_on_other_type: bool = False,
     **kwargs: Any,
 ) -> Any:
-    """Map ``func`` over every tensor leaf of a nested list/tuple/dict structure,
-    leaving other leaves untouched (the idiom every collective here uses —
-    reference `operations.py:85-134`)."""
-    if isinstance(data, (tuple, list)):
-        return honor_type(
-            data,
-            (
-                recursively_apply(
-                    func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
-                )
-                for o in data
-            ),
-        )
+    """Map ``func`` over every tensor leaf of a nested structure, leaving other
+    leaves untouched (capability of reference `operations.py:85-134`, realized as
+    a shim over the pytree machinery: ``jax.tree.map`` handles sequences,
+    namedtuples and registered custom nodes). Mappings — including plain dicts —
+    are descended by hand instead, because (a) JAX's dict flattening sorts keys,
+    which would silently reorder user batches and crash on non-comparable mixed
+    key types, and (b) Mapping subclasses like HF's BatchEncoding aren't
+    registered pytree nodes at all. ``test_type`` doubles as ``is_leaf`` so
+    callers can stop descent at custom aggregate types."""
+
+    def on_leaf(x: Any) -> Any:
+        if test_type(x):
+            return func(x, *args, **kwargs)
+        if isinstance(x, Mapping):
+            return type(x)(
+                {
+                    k: recursively_apply(
+                        func, v, *args, test_type=test_type,
+                        error_on_other_type=error_on_other_type, **kwargs,
+                    )
+                    for k, v in x.items()
+                }
+            )
+        if error_on_other_type:
+            raise TypeError(
+                f"Unsupported type {type(x)} passed: only nested containers of arrays are handled."
+            )
+        return x
+
+    return jax.tree.map(
+        on_leaf, data, is_leaf=lambda x: test_type(x) or isinstance(x, Mapping)
+    )
+
+
+def as_registered_pytree(data: Any) -> Any:
+    """Convert Mapping subclasses that are NOT plain dicts (HF BatchEncoding /
+    ModelOutput, UserDict, …) into dicts, recursively — a jitted step can only
+    trace containers the pytree registry knows. Everything else passes through."""
     if isinstance(data, Mapping):
-        return type(data)(
-            {
-                k: recursively_apply(
-                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
-                )
-                for k, v in data.items()
-            }
-        )
-    if test_type(data):
-        return func(data, *args, **kwargs)
-    if error_on_other_type:
-        raise TypeError(
-            f"Unsupported type {type(data)} passed: only nested containers of arrays are handled."
-        )
+        return {k: as_registered_pytree(v) for k, v in data.items()}
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(as_registered_pytree(v) for v in data))
+    if isinstance(data, (list, tuple)):
+        return type(data)(as_registered_pytree(v) for v in data)
     return data
 
 
@@ -109,17 +118,21 @@ def slice_tensors(data: Any, tensor_slice: slice) -> Any:
 
 
 def concatenate(data: list, dim: int = 0) -> Any:
-    """Concatenate a list of same-structure pytrees leafwise (reference `operations.py:605`)."""
-    first = data[0]
-    if isinstance(first, (tuple, list)):
-        return honor_type(first, (concatenate([d[i] for d in data], dim=dim) for i in range(len(first))))
-    if isinstance(first, Mapping):
-        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first.keys()})
-    if not is_tensor(first):
-        raise TypeError(f"Can only concatenate containers of arrays, got {type(first)}.")
-    if isinstance(first, np.ndarray):
-        return np.concatenate(data, axis=dim)
-    return jnp.concatenate(data, axis=dim)
+    """Concatenate a list of same-structure pytrees leafwise (capability of
+    reference `operations.py:605`; here one multi-tree ``jax.tree.map``)."""
+
+    def _cat(*leaves: Any) -> Any:
+        if isinstance(leaves[0], Mapping):  # descended by hand: see recursively_apply
+            return type(leaves[0])(
+                {k: concatenate([l[k] for l in leaves], dim=dim) for k in leaves[0].keys()}
+            )
+        if not is_tensor(leaves[0]):
+            raise TypeError(f"Can only concatenate containers of arrays, got {type(leaves[0])}.")
+        if isinstance(leaves[0], np.ndarray):
+            return np.concatenate(leaves, axis=dim)
+        return jnp.concatenate(leaves, axis=dim)
+
+    return jax.tree.map(_cat, *data, is_leaf=lambda x: isinstance(x, Mapping))
 
 
 # ---------------------------------------------------------------- debug verify

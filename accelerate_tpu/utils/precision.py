@@ -93,6 +93,21 @@ class DynamicGradScaler:
     growth_factor: float = 2.0
     backoff_factor: float = 0.5
     growth_interval: int = 2000
+    # The total scale S is split: min(S, max_inner_scale) multiplies the loss
+    # INSIDE the reduced-precision backward (underflow protection; small enough
+    # that healthy cotangent chains stay under fp16's 65504), and the remainder
+    # S/inner is applied to the fp32 grads outside. Overflow backoff stays a
+    # real feedback loop — sustained non-finite steps halve S until the inner
+    # factor itself shrinks and the fp16 cotangents come back in range.
+    max_inner_scale: float = 2.0**10
+    # Ceiling on S: the outer factor is numerically exact in fp32 (powers of
+    # two), so growth on a long healthy run must not walk S toward fp32 inf.
+    max_scale: float = 2.0**24
+
+    def split_scale(self, scale: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """(inner, outer) with inner*outer == scale and inner fp16-safe."""
+        inner = jnp.minimum(scale, self.max_inner_scale)
+        return inner, scale / inner
 
     def init(self) -> GradScalerState:
         return GradScalerState(
@@ -116,7 +131,7 @@ class DynamicGradScaler:
         grow = new_tracker >= self.growth_interval
         new_scale = jnp.where(
             finite,
-            jnp.where(grow, state.scale * self.growth_factor, state.scale),
+            jnp.where(grow, jnp.minimum(state.scale * self.growth_factor, self.max_scale), state.scale),
             state.scale * self.backoff_factor,
         )
         new_tracker = jnp.where(grow, 0, new_tracker)

@@ -318,6 +318,36 @@ class TestAcceleratorBasics:
         gc.collect()
         assert len(per_model) == 0
 
+    def test_grad_fn_cache_unhashable_loss_fn(self):
+        """A weakref-able but unhashable callable must fall back to the
+        no-cache path, not crash backward()."""
+        acc = _fresh_accelerator()
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        batch = {k: jnp.asarray(v) for k, v in make_regression_batches(1, 16)[0].items()}
+
+        class UnhashableLoss:
+            __hash__ = None
+
+            def __call__(self, m, b):
+                return regression_loss_fn(m, b)
+
+        loss = acc.backward(UnhashableLoss(), batch)
+        assert np.isfinite(float(loss))
+        assert len(acc._grad_fns[model]) == 0  # nothing cached
+
+    def test_fp16_scale_growth_is_capped(self):
+        """Grad-side scaling has no overflow feedback during healthy training,
+        so the growth rule must clamp at max_scale instead of running to inf."""
+        from accelerate_tpu.utils.precision import DynamicGradScaler
+
+        scaler = DynamicGradScaler(init_scale=2.0**23, growth_interval=1)
+        state = scaler.init()
+        grads = {"a": jnp.ones(2)}
+        for _ in range(4):
+            _, state, finite = scaler.unscale_and_update(grads, state)
+            assert bool(finite)
+        assert float(state.scale) == scaler.max_scale
+
     def test_scheduler_steps_only_on_sync(self):
         from accelerate_tpu.scheduler import OptaxSchedule
 

@@ -164,6 +164,25 @@ def test_dataloader_ragged_batch_padded_to_static_shape():
     np.testing.assert_array_equal(np.asarray(out[1])[12:], [0, 1, 2, 3])
 
 
+def test_dataloader_mapping_subclass_batch_crosses_jit():
+    """A tokenizer-style Mapping batch (HF BatchEncoding is a UserDict) must be
+    normalized to a plain dict of device arrays so the jitted step can trace it."""
+    from collections import UserDict
+
+    import jax
+
+    class BatchEncoding(UserDict):
+        pass
+
+    batches = [BatchEncoding({"ids": np.arange(8), "inner": {"m": np.ones((8, 2), np.float32)}})]
+    dl = DataLoaderShard(batches)
+    out = list(dl)[0]
+    assert type(out) is dict and type(out["inner"]) is dict
+    assert isinstance(out["ids"], jax.Array)
+    summed = jax.jit(lambda b: b["inner"]["m"].sum())(out)  # traces fine
+    assert float(summed) == 16.0
+
+
 def test_remainder_precomputed():
     dl = DataLoaderShard([np.zeros((16,))], total_batch_size=16, total_dataset_length=44)
     assert dl.remainder == 44 % 16

@@ -41,6 +41,44 @@ def test_recursively_apply_namedtuple():
     np.testing.assert_array_equal(out.x, np.full(2, 2.0))
 
 
+def test_recursively_apply_mapping_subclass():
+    """HF BatchEncoding/ModelOutput are Mapping subclasses NOT registered as
+    pytree nodes — they must still be descended into, and the container type
+    preserved."""
+    from collections import UserDict
+
+    class Batch(UserDict):
+        pass
+
+    data = Batch({"ids": np.arange(3), "nested": {"m": np.ones(2)}, "s": "keep"})
+    out = recursively_apply(lambda t: t * 2, data)
+    assert isinstance(out, Batch)
+    np.testing.assert_array_equal(out["ids"], np.array([0, 2, 4]))
+    np.testing.assert_array_equal(out["nested"]["m"], np.full(2, 2.0))
+    assert out["s"] == "keep"
+
+
+def test_recursively_apply_preserves_dict_key_order_and_mixed_keys():
+    data = {"z_last": np.ones(1), "a_first": np.zeros(1)}
+    out = recursively_apply(lambda t: t + 1, data)
+    assert list(out.keys()) == ["z_last", "a_first"]  # NOT sorted
+    mixed = {1: np.ones(1), "a": np.zeros(1)}  # non-comparable key types
+    out = recursively_apply(lambda t: t + 1, mixed)
+    np.testing.assert_array_equal(out[1], [2.0])
+
+
+def test_concatenate_mapping_subclass():
+    from collections import UserDict
+
+    class Out(UserDict):
+        pass
+
+    a, b = Out({"x": np.ones((2, 3))}), Out({"x": np.zeros((1, 3))})
+    cat = concatenate([a, b])
+    assert isinstance(cat, Out)
+    assert cat["x"].shape == (3, 3)
+
+
 def test_send_to_device():
     data = {"a": np.arange(4.0), "s": "str"}
     out = send_to_device(data, jax.devices()[0])
