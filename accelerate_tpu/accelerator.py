@@ -239,9 +239,43 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         rng_types: list[str] | None = None,
         dispatch_batches: bool | None = None,
+        dataloader_config: Any = None,
+        deepspeed_plugin: Any = None,
+        fsdp_plugin: Any = None,
+        megatron_lm_plugin: Any = None,
+        kwargs_handlers: list[Any] | None = None,
         **kwargs: Any,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        # ---- engine plugins + kwargs handlers (reference accelerator.py:246-412):
+        # resolve the migration-surface objects into the run plan BEFORE state
+        # is built, so ds_config-derived precision/parallelism actually apply.
+        (
+            mixed_precision,
+            gradient_accumulation_steps,
+            parallelism_config,
+            scaler_config,
+            init_pg_timeout,
+        ) = self._resolve_plugins(
+            mixed_precision,
+            gradient_accumulation_steps,
+            parallelism_config,
+            deepspeed_plugin,
+            fsdp_plugin,
+            megatron_lm_plugin,
+            kwargs_handlers,
+        )
+        self._use_seedable_sampler = True
+        if dataloader_config is not None:
+            if split_batches or not even_batches or dispatch_batches is not None:
+                raise ValueError(
+                    "Pass dataloader behavior EITHER via dataloader_config= OR via the "
+                    "split_batches/even_batches/dispatch_batches kwargs, not both."
+                )
+            split_batches = dataloader_config.split_batches
+            even_batches = dataloader_config.even_batches
+            dispatch_batches = dataloader_config.dispatch_batches
+            self._use_seedable_sampler = dataloader_config.use_seedable_sampler
         if parallelism_config is None:
             # launcher env contract (commands/launch.py): dp,fsdp,stage,seq,tp
             env_par = os.environ.get("ACCELERATE_TPU_PARALLELISM")
@@ -254,10 +288,16 @@ class Accelerator:
         if gradient_accumulation_steps == 1:
             gradient_accumulation_steps = int(os.environ.get("ACCELERATE_TPU_GRAD_ACCUM_STEPS", 1))
         self.state = AcceleratorState(
-            mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
+            mixed_precision=mixed_precision,
+            cpu=cpu,
+            parallelism_config=parallelism_config,
+            initialization_timeout=init_pg_timeout,
         )
         self.policy = PrecisionPolicy.from_mode(self.state.mixed_precision)
-        self.scaler = DynamicGradScaler() if self.policy.requires_loss_scaling else None
+        if self.policy.requires_loss_scaling:
+            self.scaler = DynamicGradScaler(**scaler_config) if scaler_config.pop("enabled", True) else None
+        else:
+            self.scaler = None
         if gradient_accumulation_plugin is not None:
             self.gradient_state = GradientState(
                 gradient_accumulation_steps=gradient_accumulation_plugin.num_steps,
@@ -285,6 +325,98 @@ class Accelerator:
         self._train_steps: dict[tuple, Any] = {}
         self.trackers: list = []
         self._log_with = log_with
+
+    def _resolve_plugins(
+        self,
+        mixed_precision,
+        gradient_accumulation_steps,
+        parallelism_config,
+        deepspeed_plugin,
+        fsdp_plugin,
+        megatron_lm_plugin,
+        kwargs_handlers,
+    ):
+        """Resolve engine plugins + kwargs handlers into the run plan — the
+        reference ctor's plugin negotiation (`accelerator.py:246-412`), with the
+        engines collapsed onto mesh axes. Env activation mirrors the reference's
+        ``ACCELERATE_USE_DEEPSPEED``/``_FSDP``/``_MEGATRON_LM`` switches."""
+        from .utils.dataclasses import (
+            DataLoaderConfiguration,
+            DeepSpeedPlugin,
+            DistributedDataParallelKwargs,
+            FP8RecipeKwargs,
+            FullyShardedDataParallelPlugin,
+            GradScalerKwargs,
+            InitProcessGroupKwargs,
+            MegatronLMPlugin,
+            ProfileKwargs,
+        )
+        from .utils.environment import parse_flag_from_env
+
+        if deepspeed_plugin is None and parse_flag_from_env("ACCELERATE_TPU_USE_DEEPSPEED"):
+            deepspeed_plugin = DeepSpeedPlugin(
+                hf_ds_config=os.environ.get("ACCELERATE_TPU_DEEPSPEED_CONFIG_FILE") or None
+            )
+        if fsdp_plugin is None and parse_flag_from_env("ACCELERATE_TPU_USE_FSDP"):
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+        if megatron_lm_plugin is None and parse_flag_from_env("ACCELERATE_TPU_USE_MEGATRON_LM"):
+            megatron_lm_plugin = MegatronLMPlugin()
+        engines = [p for p in (deepspeed_plugin, fsdp_plugin, megatron_lm_plugin) if p is not None]
+        if len(engines) > 1:
+            raise ValueError(
+                "Pass at most one of deepspeed_plugin / fsdp_plugin / megatron_lm_plugin."
+            )
+        self.deepspeed_plugin = deepspeed_plugin
+        self.fsdp_plugin = fsdp_plugin
+        self.megatron_lm_plugin = megatron_lm_plugin
+
+        self.ddp_handler = None
+        self.profile_handler = None
+        self.fp8_recipe_handler = None
+        self.init_handler = None
+        scaler_kwargs = None
+        seen: set[type] = set()
+        for handler in kwargs_handlers or []:
+            if type(handler) in seen:
+                raise ValueError(f"Duplicate kwargs handler of type {type(handler).__name__}.")
+            seen.add(type(handler))
+            if isinstance(handler, GradScalerKwargs):
+                scaler_kwargs = handler
+            elif isinstance(handler, DistributedDataParallelKwargs):
+                self.ddp_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe_handler = handler
+            elif isinstance(handler, InitProcessGroupKwargs):
+                self.init_handler = handler
+            elif isinstance(handler, DataLoaderConfiguration):
+                raise ValueError("Pass DataLoaderConfiguration as dataloader_config=, not a handler.")
+            else:
+                raise ValueError(f"Unsupported kwargs handler: {handler!r}")
+
+        self.gradient_clipping = None
+        if deepspeed_plugin is not None:
+            if mixed_precision is None and getattr(deepspeed_plugin, "mixed_precision", None):
+                mixed_precision = deepspeed_plugin.mixed_precision
+            if gradient_accumulation_steps == 1 and deepspeed_plugin.gradient_accumulation_steps > 1:
+                gradient_accumulation_steps = deepspeed_plugin.gradient_accumulation_steps
+            if deepspeed_plugin.gradient_clipping is not None:
+                self.gradient_clipping = deepspeed_plugin.gradient_clipping
+            if parallelism_config is None:
+                # stage >=3 -> fsdp over all devices; stages 0-2 -> the default
+                # data mesh (opt-state sharding is a placement choice downstream)
+                parallelism_config = deepspeed_plugin.to_parallelism_config(0)
+        elif fsdp_plugin is not None and parallelism_config is None:
+            parallelism_config = fsdp_plugin.to_parallelism_config()
+        elif megatron_lm_plugin is not None and parallelism_config is None:
+            parallelism_config = megatron_lm_plugin.to_parallelism_config()
+
+        scaler_config: dict[str, Any] = {}
+        if scaler_kwargs is not None:
+            scaler_config = scaler_kwargs.to_dict()
+        timeout = self.init_handler.timeout_seconds if self.init_handler is not None else None
+        return mixed_precision, gradient_accumulation_steps, parallelism_config, scaler_config, timeout
 
     # ------------------------------------------------------------- topology
     @property
@@ -495,6 +627,7 @@ class Accelerator:
             rng_types=self.rng_types,
             dispatch_batches=self.dispatch_batches,
             even_batches=self.even_batches,
+            use_seedable_sampler=self._use_seedable_sampler,
             mesh=self.mesh,
         )
         self._dataloaders.append(prepared)
@@ -712,6 +845,11 @@ class Accelerator:
             model = self._models[0]
         if optimizer is None:
             optimizer = self._optimizer_for(model)
+        if max_grad_norm is None:
+            # ds_config gradient_clipping (reference applies it inside the engine)
+            max_grad_norm = self.gradient_clipping
+        if comm_hook is None and self.ddp_handler is not None:
+            comm_hook = self.ddp_handler.to_comm_hook_config()
         policy = self.policy
         tx = optimizer.optimizer
         # NOTE: gradient_accumulation_steps is read LIVE from gradient_state at
@@ -942,9 +1080,18 @@ class Accelerator:
     @contextlib.contextmanager
     def profile(self, profile_handler: Any = None, log_dir: str | None = None):
         """jax.profiler trace context, one trace per host (reference
-        `accelerator.py:3449-3506` / torch.profiler)."""
-        target = log_dir or (self.project_configuration.logging_dir or "profile_traces")
-        jax.profiler.start_trace(target)
+        `accelerator.py:3449-3506` / torch.profiler). ``profile_handler``
+        defaults to the ProfileKwargs passed via ``kwargs_handlers``."""
+        handler = profile_handler or self.profile_handler
+        target = log_dir or (
+            (handler.output_trace_dir if handler is not None else None)
+            or self.project_configuration.logging_dir
+            or "profile_traces"
+        )
+        jax.profiler.start_trace(
+            target,
+            create_perfetto_link=bool(handler.create_perfetto_link) if handler is not None else False,
+        )
         try:
             yield
         finally:

@@ -34,7 +34,12 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .state import AcceleratorState, GradientState, PartialState
 from .parallel.mesh import data_axes
-from .utils.operations import as_registered_pytree, recursively_apply, broadcast_object_list
+from .utils.operations import (
+    as_registered_pytree,
+    broadcast_object_list,
+    find_batch_size,
+    recursively_apply,
+)
 from .utils.random import get_rng_key, synchronize_rng_states
 
 
@@ -381,6 +386,14 @@ class DataLoaderShard:
         num_processes = PartialState().num_processes
         per_process_shards = max(shards // num_processes, 1)
 
+        # A ragged final batch on ONE process is the whole global batch: record
+        # how many samples are real so gather_for_metrics can drop the wrap
+        # padding (sized datasets precompute this in __init__; iterables can't).
+        if num_processes == 1 and self.end_of_dataloader and self.remainder < 0:
+            bs = find_batch_size(batch)
+            if bs is not None and bs % per_process_shards != 0:
+                self.remainder = bs
+
         def _place(t):
             t = _leaf_to_numpy(t)
             if t.ndim >= 1 and t.shape[0] % per_process_shards != 0:
@@ -454,6 +467,14 @@ class DataLoaderDispatcher(DataLoaderShard):
 
     On TPU pods this trades DCN broadcast bandwidth for not needing a splittable
     dataset on every host — same trade the reference makes over NCCL.
+
+    Ragged final batch: the reference completes it from ``first_batch`` under
+    ``even_batches`` and yields uneven slices otherwise
+    (`data_loader.py:812-850`). XLA shardings require equal shards, so here the
+    batch is always completed (wrapping its own samples) and the real sample
+    count is recorded in ``remainder`` — ``gather_for_metrics`` drops the
+    duplicates, so metrics are dataset-exact either way and ``even_batches``
+    has no separate meaning on this path.
     """
 
     def __iter__(self):
@@ -489,11 +510,31 @@ class DataLoaderDispatcher(DataLoaderShard):
                 if info["stop"]:
                     break
                 self.end_of_dataloader = info["last"]
-                # slice this host's share of the global batch
+                # Slice this host's share of the global batch, completing a
+                # ragged batch by wrapping so every process gets equal shapes.
+                # The wrap target is aligned to per-process SHARD count too, so
+                # downstream _to_global never pads mid-array — all padding sits
+                # at the global tail and gather_for_metrics' [:remainder] is
+                # exact.
                 nproc = state.num_processes
+                per_align = 1
+                if self.device_placement:
+                    mesh = self._data_sharding().mesh
+                    shards = math.prod(mesh.shape[a] for a in data_axes(mesh))
+                    per_align = max(shards // nproc, 1)
+                bs = find_batch_size(info["batch"])
+                per = max(-(-bs // nproc), 1) if bs else 0
+                per = -(-per // per_align) * per_align
+                if bs and per * nproc != bs:
+                    if self._drop_last and self.end_of_dataloader:
+                        idx += 1
+                        continue
+                    if self.end_of_dataloader and self.remainder < 0:
+                        self.remainder = bs
 
                 def _slice(t):
-                    per = t.shape[0] // nproc
+                    if t.shape[0] != per * nproc:
+                        t = t[(np.arange(per * nproc) % t.shape[0])]
                     start = per * state.process_index
                     return t[start : start + per]
 

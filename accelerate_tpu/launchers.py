@@ -36,9 +36,18 @@ def notebook_launcher(
     function(*args)
 
 
-def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2) -> None:
+def debug_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: int = 2,
+    devices_per_process: int = 1,
+) -> None:
     """Fork ``num_processes`` CPU 'hosts' over a localhost coordinator and run
     ``function(*args)`` in each (reference `launchers.py:269` — 2-proc gloo CPU).
+
+    ``devices_per_process`` > 1 gives each child that many virtual CPU devices
+    (host-platform multiplexing) — a pod-slice topology (N hosts × M chips)
+    without hardware.
 
     The function must be importable (defined in a module, not a closure): each
     child imports it by qualified name, mirroring how torch's spawn pickles.
@@ -77,6 +86,13 @@ def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2)
                 "ACCELERATE_TPU_NUM_PROCESSES": str(num_processes),
             }
         )
+        if devices_per_process > 1:
+            flags = [
+                f for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            ]
+            flags.append(f"--xla_force_host_platform_device_count={devices_per_process}")
+            env["XLA_FLAGS"] = " ".join(flags)
         procs.append(subprocess.Popen([sys.executable, "-c", runner], env=env))
     codes = [p.wait() for p in procs]
     if any(codes):

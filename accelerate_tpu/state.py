@@ -43,13 +43,15 @@ class DistributedType(str):
     MULTI_HOST = "MULTI_HOST"  # >1 JAX process
 
 
-def _maybe_init_distributed() -> None:
+def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
     """Initialize jax.distributed from the launcher env contract if present.
 
     Env contract (set by `commands/launch.py`): ``JAX_COORDINATOR_ADDRESS``,
     ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``. On Cloud TPU pods, plain
     ``jax.distributed.initialize()`` autodetects everything from metadata; the env
     vars only override. Mirrors the role of reference `state.py:212` init_process_group.
+    ``initialization_timeout`` comes from ``InitProcessGroupKwargs.timeout_seconds``
+    (reference `InitProcessGroupKwargs.timeout` -> init_process_group).
     """
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("ACCELERATE_TPU_NUM_PROCESSES")
@@ -60,11 +62,15 @@ def _maybe_init_distributed() -> None:
     if jax.distributed.is_initialized():
         return
     pid = os.environ.get("JAX_PROCESS_ID")
+    extra: dict[str, Any] = {}
+    if initialization_timeout is not None:
+        extra["initialization_timeout"] = int(initialization_timeout)
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(nproc) if nproc else None,
             process_id=int(pid) if pid is not None else None,
+            **extra,
         )
     except (RuntimeError, ValueError) as e:  # already initialized or single-proc
         logger.debug("jax.distributed.initialize skipped: %s", e)
@@ -90,8 +96,8 @@ class PartialState:
                 return
             self._init(cpu=cpu, **kwargs)
 
-    def _init(self, cpu: bool = False, **kwargs: Any) -> None:
-        _maybe_init_distributed()
+    def _init(self, cpu: bool = False, initialization_timeout: int | None = None, **kwargs: Any) -> None:
+        _maybe_init_distributed(initialization_timeout)
         self.debug = parse_flag_from_env("ACCELERATE_TPU_DEBUG_MODE")
         self._cpu = cpu
         self.devices = jax.devices()

@@ -1,0 +1,92 @@
+"""Checkpoint save -> fresh-runtime restore -> bit-exact resume on 2 real JAX
+processes (reference `test_utils/scripts/external_deps/test_checkpointing.py`
+role). Phase A trains 3 boundaries with fp16 (so scaler state is live), saves
+via orbax sharded save. Phase B rebuilds Accelerator/model/optimizer from
+scratch in the same processes, restores, trains 2 more boundaries. The result
+must be bit-identical to an uninterrupted 5-boundary run."""
+
+
+def _build(acc):
+    import jax.numpy as jnp
+    import optax
+
+    params = {"w": jnp.zeros((4,)), "b": jnp.zeros(())}
+
+    def apply_fn(p, x):
+        return x @ p["w"] + p["b"]
+
+    model, opt = acc.prepare((apply_fn, params), optax.adam(0.05))
+    return model, opt
+
+
+def _batches():
+    import numpy as np
+
+    rng = np.random.RandomState(3)
+    W = np.array([0.5, -1.0, 1.5, 2.0], dtype=np.float32)
+    xs = rng.randn(5, 16, 4).astype(np.float32)
+    return [{"x": xs[i], "y": xs[i] @ W + 0.1} for i in range(5)]
+
+
+def _loss(m, b):
+    return ((m(b["x"]) - b["y"]) ** 2).mean()
+
+
+def run_checks(ckpt_dir):
+    import jax
+    import numpy as np
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2, state.num_processes
+    batches = _batches()
+
+    def fresh_accelerator():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        return Accelerator(mixed_precision="fp16")
+
+    def train(acc, model, opt, batch_slice):
+        step = acc.make_train_step(_loss)
+        for b in batch_slice:
+            step(b)
+
+    # --- uninterrupted run -------------------------------------------------
+    acc = fresh_accelerator()
+    model, opt = _build(acc)
+    train(acc, model, opt, batches)
+    expect = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), acc.get_state_dict(model))
+    expect_opt_steps = opt._num_updates
+
+    # --- phase A: train 3, save -------------------------------------------
+    acc = fresh_accelerator()
+    model, opt = _build(acc)
+    train(acc, model, opt, batches[:3])
+    acc.save_state(ckpt_dir)
+    state.wait_for_everyone()
+
+    # --- phase B: fresh runtime objects, restore, resume -------------------
+    acc = fresh_accelerator()
+    model, opt = _build(acc)
+    acc.load_state(ckpt_dir)
+    assert opt._num_updates == 3, opt._num_updates
+    assert opt.scaler_state is not None
+    train(acc, model, opt, batches[3:])
+    got = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), acc.get_state_dict(model))
+    assert opt._num_updates == expect_opt_steps
+
+    for k in expect:
+        np.testing.assert_array_equal(got[k], expect[k]), k
+    state.wait_for_everyone()
+    print(f"proc {state.process_index}: checkpoint resume bit-exact OK", flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks(sys.argv[1])

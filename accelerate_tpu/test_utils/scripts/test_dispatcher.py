@@ -1,0 +1,44 @@
+"""DataLoaderDispatcher loop on 2 real JAX processes (reference
+`test_utils/scripts/test_distributed_data_loop.py` role): process 0 reads an
+UNEVEN iterable dataset, broadcasts each global batch, every process slices its
+share; the ragged final batch is completed by wrapping and recorded in
+`remainder`, so gather_for_metrics returns exactly the dataset."""
+
+
+def run_checks():
+    import numpy as np
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.data_loader import DataLoaderDispatcher
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2, state.num_processes
+
+    # 27 samples in batches of 8: final batch has 3 -> not divisible by 2 procs
+    data = np.arange(27.0)
+    batches = [data[i : i + 8] for i in range(0, 27, 8)]
+    # only the main process actually has the dataset (iterable semantics)
+    source = batches if state.is_main_process else []
+
+    acc = Accelerator()
+    dl = acc.prepare(DataLoaderDispatcher(source))
+    seen = []
+    sizes = []
+    for batch in dl:
+        sizes.append(batch.shape[0])
+        seen.append(np.asarray(acc.gather_for_metrics(batch)))
+    # every global batch is shape-complete (XLA equal-shard requirement)
+    assert all(s % 2 == 0 for s in sizes), sizes
+    out = np.concatenate(seen)
+    np.testing.assert_array_equal(out, data)
+    assert dl.remainder == 3, dl.remainder
+    state.wait_for_everyone()
+    print(f"proc {state.process_index}: dispatcher uneven-dataset loop OK", flush=True)
+
+
+if __name__ == "__main__":
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks()

@@ -377,8 +377,11 @@ def find_batch_size(data: Any) -> int | None:
             if bs is not None:
                 return bs
         return None
-    if is_tensor(data) and data.ndim >= 1:
-        return int(data.shape[0])
+    # any array-like with a leading dim counts (torch tensors included — the
+    # loaders call this on raw user batches before leaf conversion)
+    shape = getattr(data, "shape", None)
+    if shape is not None and len(shape) >= 1:
+        return int(shape[0])
     return None
 
 

@@ -183,6 +183,43 @@ def test_dataloader_mapping_subclass_batch_crosses_jit():
     assert float(summed) == 16.0
 
 
+def test_iterable_ragged_final_batch_gather_for_metrics_exact():
+    """An iterable dataset (no precomputed length) whose final batch is ragged:
+    the wrap padding must be recorded in `remainder` so gather_for_metrics
+    returns exactly dataset-length samples."""
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator()
+    batches = [np.arange(16.0), np.arange(16.0, 27.0)]  # 27 samples, last ragged
+    dl = acc.prepare(DataLoaderShard(batches))
+    seen = []
+    for batch in dl:
+        seen.append(np.asarray(acc.gather_for_metrics(batch)))
+    out = np.concatenate(seen)
+    np.testing.assert_array_equal(out, np.arange(27.0))
+
+
+def test_torch_tensor_ragged_final_batch_remainder_recorded():
+    """find_batch_size must see torch tensors (raw user batches) so the wrap
+    padding of a ragged final torch batch is recorded in `remainder`."""
+    import torch
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator()
+    batches = [torch.arange(16.0), torch.arange(16.0, 27.0)]  # last has 11
+    dl = acc.prepare(DataLoaderShard(batches))
+    seen = [np.asarray(acc.gather_for_metrics(b)) for b in dl]
+    np.testing.assert_array_equal(np.concatenate(seen), np.arange(27.0))
+    assert dl.remainder == 11
+
+
 def test_remainder_precomputed():
     dl = DataLoaderShard([np.zeros((16,))], total_batch_size=16, total_dataset_length=44)
     assert dl.remainder == 44 % 16

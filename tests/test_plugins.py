@@ -1,0 +1,184 @@
+"""Plugin dataclasses consumed by `Accelerator(...)` — the migration contract
+(reference `accelerator.py:246-412` resolves deepspeed/fsdp/megatron plugins,
+kwargs handlers, and env activation into the run plan)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils.dataclasses import (
+    DeepSpeedPlugin,
+    DistributedDataParallelKwargs,
+    FullyShardedDataParallelPlugin,
+    GradScalerKwargs,
+    InitProcessGroupKwargs,
+    MegatronLMPlugin,
+    ProfileKwargs,
+)
+from accelerate_tpu.test_utils.training import (
+    make_regression_batches,
+    regression_apply_fn,
+    regression_loss_fn,
+    regression_model_params,
+)
+
+
+def _fresh(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _ds_config(tmp_path, **body):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps(body))
+    return str(path)
+
+
+class TestDeepSpeedPlugin:
+    def test_ds_config_bf16_activates_mixed_precision(self, tmp_path):
+        cfg = _ds_config(tmp_path, bf16={"enabled": True})
+        acc = _fresh(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        assert acc.state.mixed_precision == "bf16"
+        assert acc.policy.compute_dtype == jnp.bfloat16
+
+    def test_ds_config_fp16_activates_scaler(self, tmp_path):
+        cfg = _ds_config(tmp_path, fp16={"enabled": True})
+        acc = _fresh(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        assert acc.state.mixed_precision == "fp16"
+        assert acc.scaler is not None
+
+    def test_explicit_mixed_precision_wins(self, tmp_path):
+        cfg = _ds_config(tmp_path, bf16={"enabled": True})
+        acc = _fresh(mixed_precision="no", deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        assert acc.state.mixed_precision == "no"
+
+    def test_ds_config_grad_accum_and_clipping(self, tmp_path):
+        cfg = _ds_config(tmp_path, gradient_accumulation_steps=4, gradient_clipping=0.5)
+        acc = _fresh(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        assert acc.gradient_state.num_steps == 4
+        assert acc.gradient_clipping == 0.5
+
+    def test_zero3_maps_to_fsdp_mesh(self, tmp_path):
+        cfg = _ds_config(tmp_path, zero_optimization={"stage": 3})
+        acc = _fresh(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        assert acc.state.mesh.shape["fsdp"] == len(jax.devices())
+
+    def test_gradient_clipping_applied_in_fused_step(self, tmp_path):
+        cfg = _ds_config(tmp_path, gradient_clipping=1e-6)
+        acc = _fresh(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg))
+        model, opt = acc.prepare(
+            (regression_apply_fn, regression_model_params()), optax.sgd(1.0)
+        )
+        step = acc.make_train_step(regression_loss_fn)
+        batch = {k: jnp.asarray(v) for k, v in make_regression_batches(1, 16)[0].items()}
+        before = np.asarray(model.params["a"]).copy()
+        step(batch)
+        delta = np.abs(np.asarray(model.params["a"]) - before).max()
+        # lr=1.0 with grads clipped to global norm 1e-6: the update is tiny
+        assert 0 < delta < 1e-5
+
+    def test_env_activation(self, tmp_path, monkeypatch):
+        cfg = _ds_config(tmp_path, bf16={"enabled": True})
+        monkeypatch.setenv("ACCELERATE_TPU_USE_DEEPSPEED", "true")
+        monkeypatch.setenv("ACCELERATE_TPU_DEEPSPEED_CONFIG_FILE", cfg)
+        acc = _fresh()
+        assert acc.deepspeed_plugin is not None
+        assert acc.state.mixed_precision == "bf16"
+
+
+class TestOtherEnginePlugins:
+    def test_fsdp_plugin_maps_to_mesh(self):
+        acc = _fresh(fsdp_plugin=FullyShardedDataParallelPlugin())
+        assert acc.state.mesh.shape["fsdp"] == len(jax.devices())
+
+    def test_megatron_plugin_maps_to_mesh(self):
+        acc = _fresh(megatron_lm_plugin=MegatronLMPlugin(tp_degree=2, pp_degree=2))
+        assert acc.state.mesh.shape["tensor"] == 2
+        assert acc.state.mesh.shape["stage"] == 2
+
+    def test_two_engine_plugins_rejected(self):
+        with pytest.raises(ValueError, match="at most one"):
+            _fresh(
+                deepspeed_plugin=DeepSpeedPlugin(),
+                fsdp_plugin=FullyShardedDataParallelPlugin(),
+            )
+
+
+class TestKwargsHandlers:
+    def test_grad_scaler_kwargs(self):
+        acc = _fresh(
+            mixed_precision="fp16",
+            kwargs_handlers=[GradScalerKwargs(init_scale=2.0**10, growth_interval=7)],
+        )
+        assert acc.scaler.init_scale == 2.0**10
+        assert acc.scaler.growth_interval == 7
+        model, opt = acc.prepare(
+            (regression_apply_fn, regression_model_params()), optax.sgd(0.1)
+        )
+        assert float(opt.scaler_state.scale) == 2.0**10
+
+    def test_grad_scaler_disabled(self):
+        acc = _fresh(mixed_precision="fp16", kwargs_handlers=[GradScalerKwargs(enabled=False)])
+        assert acc.scaler is None
+
+    def test_ddp_kwargs_default_comm_hook(self):
+        acc = _fresh(kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")])
+        assert acc.ddp_handler is not None
+        cfg = acc.ddp_handler.to_comm_hook_config()
+        assert cfg.comm_hook == "bf16"
+
+    def test_profile_kwargs_stored(self):
+        acc = _fresh(kwargs_handlers=[ProfileKwargs(host_tracer_level=3)])
+        assert acc.profile_handler.host_tracer_level == 3
+
+    def test_duplicate_handler_rejected(self):
+        with pytest.raises(ValueError, match="Duplicate"):
+            _fresh(kwargs_handlers=[GradScalerKwargs(), GradScalerKwargs()])
+
+    def test_init_process_group_timeout_plumbed(self, monkeypatch):
+        """InitProcessGroupKwargs.timeout_seconds must reach
+        jax.distributed.initialize(initialization_timeout=...)."""
+        from accelerate_tpu import state as state_mod
+
+        captured = {}
+
+        def fake_init(**kwargs):
+            captured.update(kwargs)
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1234")
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+        state_mod._maybe_init_distributed(initialization_timeout=123)
+        assert captured.get("initialization_timeout") == 123
+        assert captured.get("coordinator_address") == "127.0.0.1:1234"
+
+    def test_init_handler_reaches_partial_state(self, monkeypatch):
+        """End-to-end: Accelerator(kwargs_handlers=[InitProcessGroupKwargs(...)])
+        forwards the timeout into PartialState's distributed init path."""
+        from accelerate_tpu import state as state_mod
+
+        captured = {}
+        orig = state_mod._maybe_init_distributed
+
+        def spy(initialization_timeout=None):
+            captured["timeout"] = initialization_timeout
+
+        monkeypatch.setattr(state_mod, "_maybe_init_distributed", spy)
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        try:
+            acc = Accelerator(kwargs_handlers=[InitProcessGroupKwargs(timeout_seconds=77)])
+            assert captured["timeout"] == 77
+            assert acc.init_handler.timeout_seconds == 77
+        finally:
+            AcceleratorState._reset_state(reset_partial_state=True)
+            PartialState()  # rebuild the singleton for later tests
+            AcceleratorState._reset_state()
