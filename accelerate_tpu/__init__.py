@@ -65,3 +65,8 @@ from .utils.dataclasses import (
     ProfileKwargs,
 )
 from .utils.random import set_seed, synchronize_rng_states
+from .utils.safetensors_io import (
+    load_checkpoint_in_model,
+    load_safetensors_checkpoint,
+    save_safetensors_checkpoint,
+)

@@ -18,6 +18,7 @@ users keep their training-loop shape.
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 import weakref
 from collections.abc import Mapping
@@ -145,6 +146,13 @@ class PreparedModel:
 
                 return apply_fn, params, extra_state, module
             if callable(fn_or_module):
+                if isinstance(params, Mapping) and "params" in params and len(params) > 1:
+                    # plain-callable analogue of the flax mutable-collections
+                    # contract: apply_fn(params, *args, extra_state=...) must
+                    # return (out, new_extra_state). Used by the torch interop
+                    # bridge for BN running stats + dropout rng.
+                    extra_state = {k: v for k, v in params.items() if k != "params"}
+                    return fn_or_module, params["params"], extra_state, fn_or_module
                 return fn_or_module, params, None, fn_or_module
         raise TypeError(
             "Model must be a (flax_module, params) or (apply_fn, params) tuple, "
@@ -945,7 +953,10 @@ class Accelerator:
             return jax.tree.map(jax.lax.with_sharding_constraint, tree, param_shardings)
 
         def make_micro(lgr):
-            @jax.jit
+            # acc / mstate / comm_err are consumed and replaced every call:
+            # donating them keeps ONE gradient accumulator in HBM instead of
+            # old+new copies during each microbatch.
+            @functools.partial(jax.jit, donate_argnums=(1, 2, 5) if donate else ())
             def micro_step(params, mstate, acc, batch, comm_rep, comm_err):
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err
@@ -1152,7 +1163,12 @@ class Accelerator:
     ) -> None:
         from .checkpointing import save_model_weights
 
-        save_model_weights(self.get_state_dict(model), save_directory, max_shard_size=max_shard_size)
+        save_model_weights(
+            self.get_state_dict(model),
+            save_directory,
+            max_shard_size=max_shard_size,
+            safe_serialization=safe_serialization,
+        )
 
     # ---------------------------------------------------------------- tracking
     def init_trackers(self, project_name: str, config: dict | None = None, init_kwargs: dict | None = None):

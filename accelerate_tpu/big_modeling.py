@@ -65,27 +65,145 @@ def infer_auto_device_map(
     max_memory: dict[str, int] | None = None,
     no_split_module_classes: Sequence[str] | None = None,
     dtype: Any | None = None,
+    clean_result: bool = True,
 ) -> dict[str, str]:
-    """Greedy first-fit of top-level blocks onto {device, cpu, disk}
-    (reference `utils/modeling.py:1096`). Blocks are the first-level keys of the
-    param tree (a transformer's embedding / layer_i / head), which are exactly
-    the reference's no-split modules."""
+    """Fit a param tree onto ordered {device(s), cpu, disk} tiers
+    (reference `utils/modeling.py:1096-1398`), with the reference solver's
+    load-bearing behaviors re-founded on pytrees:
+
+      - **per-device budgets**: ``max_memory`` keys may be ``device:i`` (or the
+        legacy pooled ``device``), filled in execution order — a block placed on
+        ``device:1`` runs after everything on ``device:0`` (offload streaming
+        preserves block order, so this is the reference's sequential pipeline).
+      - **tied weights placed together**: blocks sharing an aliased leaf (the
+        reference's `find_tied_parameters` at `:605`) are fused into one
+        placement unit whose size counts the shared buffer once, so a tied
+        embedding/head pair can never straddle tiers.
+      - **no-split modules**: a block whose *key* matches an entry of
+        ``no_split_module_classes`` (module classes have no meaning in a param
+        tree; keys are the unit of structure) is moved whole to the next tier
+        when it doesn't fit. Other oversized blocks are split into their
+        children and re-fitted (the reference's recursive descent).
+      - ``clean_result`` merges children that all landed on one tier back into
+        their parent entry (reference `clean_device_map`).
+    """
     budgets = get_max_memory(max_memory)
-    device_budget = sum(v for k, v in budgets.items() if k.startswith("device"))
-    cpu_budget = budgets.get("cpu", 0)
+    # ordered tiers: devices in index order, then cpu, then disk (unbounded)
+    tiers: list[list[Any]] = []
+    if "device" in budgets:  # legacy pooled budget
+        tiers.append(["device", budgets["device"]])
+    tiers.extend(
+        [k, budgets[k]]
+        for k in sorted(
+            (k for k in budgets if k.startswith("device:")),
+            key=lambda k: int(k.split(":")[1]),
+        )
+    )
+    tiers.append(["cpu", budgets.get("cpu", 0)])
+    tiers.append(["disk", 1 << 62])
+    no_split = tuple(no_split_module_classes or ())
     sizes = compute_module_sizes(params, dtype=dtype)
-    top_blocks = [k for k in sizes if k and "/" not in k]
-    device_map: dict[str, str] = {}
-    for block in top_blocks:
-        size = sizes[block]
-        if size <= device_budget:
-            device_map[block] = "device"
-            device_budget -= size
-        elif size <= cpu_budget:
-            device_map[block] = "cpu"
-            cpu_budget -= size
+    from .utils.modeling import find_tied_parameters
+
+    tied_groups = find_tied_parameters(params)
+
+    def block_of(leaf_path: str) -> str:
+        return leaf_path.split("/", 1)[0]
+
+    # union top-level blocks linked by tied leaves into single placement units;
+    # iterate in the state dict's insertion order — that IS execution order for
+    # blockwise models, and the fit must follow it (sizes' keys are sorted)
+    from collections.abc import Mapping as _Mapping
+
+    if isinstance(params, _Mapping):
+        top_order = [str(k) for k in params.keys()]
+    else:
+        top_order = [k for k in sizes if k and "/" not in k]
+    parent: dict[str, str] = {b: b for b in top_order if b in sizes}
+
+    def find(x: str) -> str:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    shared_bytes: dict[tuple[str, ...], int] = {}
+    for group in tied_groups:
+        blocks = sorted({block_of(p) for p in group})
+        for a, b in zip(blocks, blocks[1:]):
+            parent[find(a)] = find(b)
+        if len(blocks) > 1:
+            # the shared buffer is counted once per block in `sizes`; remember
+            # the duplicate bytes so the fused unit's size is physical
+            leaf = group[0]
+            dup = sizes.get(leaf, 0) * (len(group) - 1)
+            shared_bytes[tuple(blocks)] = shared_bytes.get(tuple(blocks), 0) + dup
+
+    units: list[tuple[list[str], int]] = []  # ([block names], bytes), in tree order
+    seen_roots: dict[str, int] = {}
+    for b in parent:
+        root = find(b)
+        if root not in seen_roots:
+            seen_roots[root] = len(units)
+            units.append(([b], sizes[b]))
         else:
-            device_map[block] = "disk"
+            names, total = units[seen_roots[root]]
+            names.append(b)
+            units[seen_roots[root]] = (names, total + sizes[b])
+    for blocks, dup in shared_bytes.items():
+        for i, (names, total) in enumerate(units):
+            if set(blocks) <= set(names):
+                units[i] = (names, total - dup)
+                break
+
+    device_map: dict[str, str] = {}
+    queue: list[tuple[list[str], int]] = list(units)
+    cursor = 0  # tiers only advance: blocks execute in order, so a later block
+    # may never land on an EARLIER device than its predecessor (the sequential
+    # offload pipeline the reference solver preserves — no backfill)
+    while queue:
+        names, size = queue.pop(0)
+        placed = False
+        for ti in range(cursor, len(tiers)):
+            tier_name, budget = tiers[ti]
+            if size <= budget:
+                for n in names:
+                    device_map[n] = tier_name
+                tiers[ti][1] = budget - size
+                cursor = ti
+                placed = True
+                break
+            # try splitting a single oversized, splittable block on the first
+            # tier that can't hold it whole (reference's recursive descent)
+            if (
+                tier_name != "disk"
+                and len(names) == 1
+                and not any(pat in names[0].rsplit("/", 1)[-1] for pat in no_split)
+            ):
+                children = [k for k in sizes if k.startswith(names[0] + "/") and k.count("/") == names[0].count("/") + 1]
+                if children:
+                    queue = [([c], sizes[c]) for c in children] + queue
+                    placed = True
+                    break
+        if not placed:
+            for n in names:
+                device_map[n] = "disk"
+
+    if clean_result:
+        device_map = clean_device_map(device_map)
+    return device_map
+
+
+def clean_device_map(device_map: dict[str, str], module_prefix: str = "") -> dict[str, str]:
+    """Merge child entries that share one placement back into the parent
+    (reference `clean_device_map`)."""
+    prefixes = {k.rsplit("/", 1)[0] for k in device_map if "/" in k}
+    for prefix in sorted(prefixes, key=lambda p: -p.count("/")):
+        children = {k: v for k, v in device_map.items() if k.startswith(prefix + "/")}
+        if children and len(set(children.values())) == 1 and prefix not in device_map:
+            for k in children:
+                del device_map[k]
+            device_map[prefix] = next(iter(children.values()))
     return device_map
 
 
@@ -104,40 +222,64 @@ class BlockwiseModel:
     offload_loader: OffloadedWeightsLoader | None = None
     sharding: Any = None  # NamedSharding for resident/streamed placement
 
-    def _block_params(self, name: str) -> Any:
-        tier = self.device_map.get(name, "device")
-        if tier == "device":
-            return self.params[name]
-        if tier == "cpu":
-            host = self.params[name]
-        else:  # disk
-            flat = {
-                k[len(name) + 1 :]: self.offload_loader[k]
-                for k in self.offload_loader
-                if k.startswith(name + "/")
-            }
-            host = unflatten_params(flat)
+    def _place_host(self, host: Any) -> Any:
         return jax.tree.map(
             lambda p: jax.device_put(p, self.sharding) if self.sharding is not None else jax.device_put(p),
             host,
         )
 
+    def _fetch_entry(self, key: str, tier: str) -> tuple[Any, list]:
+        """(placed subtree for device_map entry ``key``, transient leaves to
+        evict after the block runs — empty for resident device entries)."""
+        if tier.startswith("device"):  # "device" or per-chip "device:i"
+            return self.params[key], []
+        if tier == "cpu":
+            host = self.params[key]
+        elif key in self.offload_loader:  # disk, split down to a single leaf
+            host = self.offload_loader[key]
+        else:  # disk subtree
+            flat = {
+                k[len(key) + 1 :]: self.offload_loader[k]
+                for k in self.offload_loader
+                if k.startswith(key + "/")
+            }
+            host = unflatten_params(flat)
+        placed = self._place_host(host)
+        return placed, [p for p in jax.tree.leaves(placed) if isinstance(p, jax.Array)]
+
+    def _block_params(self, name: str) -> tuple[Any, list]:
+        if name in self.device_map or not self.device_map:
+            return self._fetch_entry(name, self.device_map.get(name, "device"))
+        # block was SPLIT by the solver: assemble from its child entries
+        sub: dict[str, Any] = {}
+        transient: list = []
+        for key, tier in self.device_map.items():
+            if not key.startswith(name + "/"):
+                continue
+            part, part_tr = self._fetch_entry(key, tier)
+            transient.extend(part_tr)
+            node = sub
+            rel = key[len(name) + 1 :].split("/")
+            for p in rel[:-1]:
+                node = node.setdefault(p, {})
+            node[rel[-1]] = part
+        if not sub:
+            raise KeyError(f"no device_map entry covers block {name!r}")
+        return sub, transient
+
     def __call__(self, x: Any) -> Any:
         names = [n for n, _ in self.block_fns]
         fns = dict(self.block_fns)
         # prefetch pipeline: launch block i+1's H2D before computing block i
-        next_params = self._block_params(names[0])
+        next_params, next_transient = self._block_params(names[0])
         for i, name in enumerate(names):
-            cur = next_params
+            cur, cur_transient = next_params, next_transient
             if i + 1 < len(names):
-                next_params = self._block_params(names[i + 1])
+                next_params, next_transient = self._block_params(names[i + 1])
             x = fns[name](cur, x)
-            if self.device_map.get(name, "device") != "device":
-                jax.tree.map(
-                    lambda p: p.delete() if isinstance(p, jax.Array) and not p.is_deleted() else None,
-                    cur,
-                    is_leaf=lambda v: isinstance(v, jax.Array),
-                )
+            for p in cur_transient:  # free streamed HBM, keep resident parts
+                if not p.is_deleted():
+                    p.delete()
         return x
 
 
@@ -148,16 +290,35 @@ def dispatch_model(
     offload_dir: str | None = None,
     sharding: Any = None,
 ) -> BlockwiseModel:
-    """Place each block per the device map (reference `big_modeling.py:306`):
-    device blocks land sharded on the mesh now, cpu blocks stay as numpy, disk
-    blocks are memmap-offloaded."""
+    """Place each block per the device map (reference `big_modeling.py:306`).
+
+    With ``sharding`` (a NamedSharding over the mesh), every device-tier block
+    lands SHARDED across all chips — the TPU-native reading of "on device",
+    where capacity is the pooled HBM. Without it, per-chip tiers ``device:i``
+    are honored literally: the block is pinned to ``jax.local_devices()[i]``,
+    matching the per-device budgets the solver computed. cpu blocks stay as
+    numpy, disk blocks are memmap-offloaded."""
     placed: dict[str, Any] = {}
     disk_flat: dict[str, np.ndarray] = {}
+    local = jax.local_devices()
+
+    def _resolve(path: str) -> Any:
+        node = state_dict
+        for part in path.split("/"):
+            node = node[part]
+        return node
+
     for name, tier in device_map.items():
-        block = state_dict[name]
-        if tier == "device":
+        block = _resolve(name)  # name may be a nested path from a split block
+        if tier.startswith("device"):  # "device" or per-chip "device:i"
+            if sharding is not None:
+                target = sharding
+            elif ":" in tier:
+                target = local[min(int(tier.split(":")[1]), len(local) - 1)]
+            else:
+                target = None
             placed[name] = jax.tree.map(
-                lambda p: jax.device_put(p, sharding) if sharding is not None else jax.device_put(p),
+                lambda p, t=target: jax.device_put(p, t) if t is not None else jax.device_put(p),
                 block,
             )
         elif tier == "cpu":

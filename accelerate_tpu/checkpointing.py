@@ -184,15 +184,27 @@ def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
         accelerator.step = _load_host_state(step_path)["step"]
 
 
-def save_model_weights(state_dict: Any, save_directory: str, max_shard_size: str | int = "10GB") -> None:
+def save_model_weights(
+    state_dict: Any,
+    save_directory: str,
+    max_shard_size: str | int = "10GB",
+    safe_serialization: bool = True,
+) -> None:
     """Consolidated (unsharded) model export for interchange (reference
-    `save_model`, `accelerator.py:2804-2919`): flax msgpack serialization, written
-    by process 0. Counterpart of the sharded orbax layout above."""
-    from flax import serialization
-
+    `save_model`, `accelerator.py:2804-2919`), written by process 0:
+    sharded ``.safetensors`` + index with tied-weight dedup by default, or flax
+    msgpack with ``safe_serialization=False``. Counterpart of the sharded orbax
+    layout above."""
     if not PartialState().is_main_process:
         return
     os.makedirs(save_directory, exist_ok=True)
+    if safe_serialization:
+        from .utils.safetensors_io import save_safetensors_checkpoint
+
+        save_safetensors_checkpoint(state_dict, save_directory, max_shard_size=max_shard_size)
+        return
+    from flax import serialization
+
     as_np = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state_dict)
     payload = serialization.msgpack_serialize(as_np)
     with open(Path(save_directory) / "model.msgpack", "wb") as f:
@@ -200,7 +212,14 @@ def save_model_weights(state_dict: Any, save_directory: str, max_shard_size: str
 
 
 def load_model_weights(save_directory: str) -> Any:
+    """Load a consolidated export — safetensors (sharded or single) or msgpack,
+    whichever is present."""
+    directory = Path(save_directory)
+    if not (directory / "model.msgpack").exists():
+        from .utils.safetensors_io import load_safetensors_checkpoint
+
+        return load_safetensors_checkpoint(directory, nested=True)
     from flax import serialization
 
-    with open(Path(save_directory) / "model.msgpack", "rb") as f:
+    with open(directory / "model.msgpack", "rb") as f:
         return serialization.msgpack_restore(f.read())

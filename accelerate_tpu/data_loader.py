@@ -488,7 +488,24 @@ class DataLoaderDispatcher(DataLoaderShard):
             if state.is_main_process:
                 def _mark_last():
                     self.end_of_dataloader = True
-                base_it = _PrefetchIterator(iter(self.base_loader), _mark_last)
+
+                source = iter(self.base_loader)
+                if self._drop_last:
+                    # drop SHORT batches before the last-batch lookahead, so
+                    # `last` lands on a batch that is actually yielded (the
+                    # epoch-end sync boundary must be observed)
+                    def _full_only(it):
+                        first_bs = None
+                        for b in it:
+                            bs = find_batch_size(b)
+                            if first_bs is None:
+                                first_bs = bs
+                            if bs is not None and first_bs is not None and bs < first_bs:
+                                continue
+                            yield b
+
+                    source = _full_only(source)
+                base_it = _PrefetchIterator(source, _mark_last)
             idx = 0
             while True:
                 if state.is_main_process:
@@ -526,9 +543,6 @@ class DataLoaderDispatcher(DataLoaderShard):
                 per = max(-(-bs // nproc), 1) if bs else 0
                 per = -(-per // per_align) * per_align
                 if bs and per * nproc != bs:
-                    if self._drop_last and self.end_of_dataloader:
-                        idx += 1
-                        continue
                     if self.end_of_dataloader and self.remainder < 0:
                         self.remainder = bs
 

@@ -165,6 +165,7 @@ class GPT2LMHead(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         position_offset: jax.Array | int = 0,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.config
         b, s = input_ids.shape
@@ -195,6 +196,11 @@ class GPT2LMHead(nn.Module):
                 x = block(cfg, name=f"block_{i}")(x, deterministic, decode)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if return_hidden:
+            # pre-head hidden states for the fused (chunked) LM loss, which
+            # applies the tied head inside the loss without ever materializing
+            # the full [batch, seq, vocab] fp32 logits tensor
+            return x.astype(cfg.dtype)
         # tied LM head: logits through the embedding matrix, fp32 accumulation
         logits = jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), wte.astype(cfg.dtype),
                             preferred_element_type=jnp.float32)
@@ -245,6 +251,58 @@ def lm_loss_fn(model, batch) -> jax.Array:
     if labels is None:
         labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
     return cross_entropy_loss(logits, labels)
+
+
+def chunked_cross_entropy(
+    hidden: jax.Array,  # [N, e] pre-head activations (compute dtype)
+    wte: jax.Array,  # [V, e] tied embedding (compute dtype)
+    labels: jax.Array,  # [N] int labels, ignore_index masked
+    ignore_index: int = -100,
+    chunk: int = 1024,
+) -> jax.Array:
+    """Head+CE fused over row chunks: the [N, V] fp32 logits never exist in HBM
+    — each [chunk, V] tile is produced, reduced to (logsumexp, label-logit) and
+    discarded; `jax.checkpoint` recomputes tiles in the backward. Cuts the LM
+    head's HBM traffic by ~V/2 per pass at the cost of one recomputed matmul.
+    (Role of reference AMP'd CE; the fusion itself is TPU-native design.)"""
+    n, e = hidden.shape
+    pad = (-n) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
+    nc = hidden.shape[0] // chunk
+    hidden = hidden.reshape(nc, chunk, e)
+    labels = labels.reshape(nc, chunk)
+
+    @jax.checkpoint
+    def one_chunk(x_c, lab_c):
+        mask = lab_c != ignore_index
+        safe = jnp.where(mask, lab_c, 0)
+        logits = jax.lax.dot_general(
+            x_c, wte, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [chunk, V] — lives only inside this chunk
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        return ((lse - ll) * mask).sum(), mask.sum()
+
+    def body(carry, xs):
+        loss, cnt = one_chunk(*xs)
+        return (carry[0] + loss, carry[1] + cnt), None
+
+    (total, count), _ = jax.lax.scan(body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (hidden, labels))
+    return total / jnp.maximum(count, 1)
+
+
+def lm_loss_fn_fused(model, batch, chunk: int = 1024) -> jax.Array:
+    """Next-token LM loss with the head fused into chunked CE (no full-logits
+    materialization). Drop-in for `lm_loss_fn` on GPT2LMHead models."""
+    hidden = model(batch["input_ids"], return_hidden=True)
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    b, s, e = hidden.shape
+    wte = model.params["wte"].astype(hidden.dtype)
+    return chunked_cross_entropy(hidden.reshape(b * s, e), wte, labels.reshape(b * s), chunk=chunk)
 
 
 def gpt2_blockwise(config: GPT2Config):

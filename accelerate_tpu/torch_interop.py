@@ -43,7 +43,10 @@ class UnsupportedTorchOp(NotImplementedError):
 
 
 def _t2n(t) -> np.ndarray:
-    return t.detach().cpu().numpy()
+    # copy: .numpy() shares memory with the torch tensor, so torch-side
+    # in-place mutation (BN running stats, optimizer steps) would leak into
+    # the captured pytree
+    return np.array(t.detach().cpu().numpy())
 
 
 def extract_params(module) -> tuple[dict[str, np.ndarray], dict[str, np.ndarray]]:
@@ -115,6 +118,33 @@ def _batch_norm(mod, params, x):
     if params.get("weight") is not None:
         y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
     return y
+
+
+def _batch_norm_train(mod, params, x):
+    """Training semantics: normalize by BATCH statistics and return updated
+    running stats (torch's exact update: biased var normalizes, unbiased var
+    feeds the running buffer, momentum default 0.1)."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    n = math.prod(x.shape[i] for i in axes)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)  # biased
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    y = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + mod.eps)
+    if params.get("weight") is not None:
+        y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+    unbiased = var * (n / max(n - 1, 1))
+    if mod.momentum is None:
+        # torch semantics: cumulative moving average, factor 1/num_batches
+        nbt = params.get("num_batches_tracked", jnp.zeros((), jnp.int64)) + 1
+        m = 1.0 / nbt.astype(jnp.float32)
+    else:
+        m = mod.momentum
+    new_mean = (1 - m) * params["running_mean"] + m * mean
+    new_var = (1 - m) * params["running_var"] + m * unbiased
+    updates = {"running_mean": new_mean, "running_var": new_var}
+    if "num_batches_tracked" in params:
+        updates["num_batches_tracked"] = params["num_batches_tracked"] + 1
+    return y, updates
 
 
 def _max_pool2d(mod, params, x):
@@ -322,16 +352,29 @@ METHOD_TABLE: dict[str, Callable] = {
 }
 
 
-def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, dict[str, np.ndarray]]:
-    """Trace a torch nn.Module and return ``(apply_fn, params)`` ready for
-    `Accelerator.prepare((apply_fn, params))`.
+def convert_torch_module(
+    module, example_args: tuple = (), train: bool = False, seed: int = 0
+) -> tuple[Callable, Any]:
+    """Trace a torch nn.Module and return ``(apply_fn, variables)`` ready for
+    `Accelerator.prepare((apply_fn, variables))`.
 
-    ``apply_fn(params, *inputs)`` replays the traced graph with JAX ops. Buffers
-    are captured as constants (closed over); parameters stay differentiable.
+    Inference (``train=False``): ``variables`` is the flat param dict; buffers
+    are captured as constants and ``apply_fn(params, *inputs)`` is pure.
+
+    Training (``train=True`` — reference capability: training arbitrary
+    ``nn.Module``s, `accelerator.py:1351+`): the graph is traced in train mode, and
+    ``variables`` is ``{"params": ..., "torch_state": {"buffers": ...,
+    "rng": seed}}`` — the mutable collections contract: ``apply_fn(params,
+    *inputs, extra_state=...)`` returns ``(out, new_extra_state)``. BatchNorm
+    normalizes by batch statistics and updates its running buffers through the
+    state; Dropout draws from a per-step PRNG key folded per call site.
+    `PreparedModel.eval()` gives inference behavior at run time (state
+    mutations discarded, but the traced train-mode graph still drops out —
+    re-convert with ``train=False`` for serving).
     """
     import torch
 
-    module = module.eval()
+    module = module.train() if train else module.eval()
     try:
         gm = torch.fx.symbolic_trace(module)
     except Exception:
@@ -342,9 +385,28 @@ def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, di
     fn_table = _build_function_table()
     submodules = dict(gm.named_modules())
 
-    def apply_fn(params: dict, *args: Any) -> Any:
+    stateful = train and (
+        bool(buffers)
+        or any(type(m).__name__ == "Dropout" and m.p > 0 for m in submodules.values())
+    )
+
+    def apply_fn(params: dict, *args: Any, extra_state: Any = None) -> Any:
         env: dict[str, Any] = {}
         arg_iter = iter(args)
+        state_in = (extra_state or {}).get("torch_state", {}) if stateful else {}
+        live_buffers = dict(state_in.get("buffers", buffers))
+        buffer_updates: dict[str, Any] = {}
+        rng_box = {"key": None, "calls": 0}
+        if stateful and "rng" in state_in:
+            rng_box["key"] = jax.random.fold_in(
+                jax.random.PRNGKey(seed), state_in["rng"].astype(jnp.uint32)
+            )
+
+        def next_dropout_key():
+            rng_box["calls"] += 1
+            if rng_box["key"] is None:
+                return None
+            return jax.random.fold_in(rng_box["key"], rng_box["calls"])
 
         def lookup(prefix: str, store: dict) -> dict:
             out = {}
@@ -376,8 +438,8 @@ def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, di
                 target = node.target
                 if target in params:
                     env[node.name] = params[target]
-                elif target in buffers:
-                    env[node.name] = jnp.asarray(buffers[target])
+                elif target in live_buffers:
+                    env[node.name] = jnp.asarray(live_buffers[target])
                 else:  # torch constants stored on the module
                     obj = gm
                     for part in target.split("."):
@@ -386,15 +448,26 @@ def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, di
             elif node.op == "call_module":
                 sub = submodules[node.target]
                 cls = type(sub).__name__
-                handler = MODULE_TABLE.get(cls)
-                if handler is None:
-                    raise UnsupportedTorchOp(f"module {cls} at {node.target}")
                 sub_params = {
-                    **{k: jnp.asarray(v) for k, v in lookup(node.target, buffers).items()},
+                    **{k: jnp.asarray(v) for k, v in lookup(node.target, live_buffers).items()},
                     **lookup(node.target, params),
                 }
                 margs = [materialize(a) for a in node.args]
-                env[node.name] = handler(sub, sub_params, *margs)
+                if stateful and cls in ("BatchNorm1d", "BatchNorm2d", "BatchNorm3d"):
+                    y, updates = _batch_norm_train(sub, sub_params, *margs)
+                    for k, v in updates.items():
+                        buffer_updates[f"{node.target}.{k}"] = v
+                    env[node.name] = y
+                elif stateful and cls == "Dropout" and sub.p > 0:
+                    key = next_dropout_key()
+                    (x_in,) = margs
+                    keep = jax.random.bernoulli(key, 1.0 - sub.p, x_in.shape)
+                    env[node.name] = jnp.where(keep, x_in / (1.0 - sub.p), 0.0)
+                else:
+                    handler = MODULE_TABLE.get(cls)
+                    if handler is None:
+                        raise UnsupportedTorchOp(f"module {cls} at {node.target}")
+                    env[node.name] = handler(sub, sub_params, *margs)
             elif node.op == "call_function":
                 handler = fn_table.get(node.target)
                 if handler is None:
@@ -412,7 +485,25 @@ def convert_torch_module(module, example_args: tuple = ()) -> tuple[Callable, di
                 mkwargs = {k: materialize(v) for k, v in node.kwargs.items()}
                 env[node.name] = handler(*margs, **mkwargs)
             elif node.op == "output":
-                return materialize(node.args[0])
+                out = materialize(node.args[0])
+                if extra_state is not None and stateful:
+                    new_buffers = {
+                        k: buffer_updates.get(k, jnp.asarray(v)) for k, v in live_buffers.items()
+                    }
+                    new_state = {
+                        "torch_state": {
+                            "buffers": new_buffers,
+                            "rng": state_in.get("rng", jnp.zeros((), jnp.uint32)) + 1,
+                        }
+                    }
+                    return out, new_state
+                return out
         raise RuntimeError("fx graph had no output node")
 
+    if stateful:
+        variables = {
+            "params": params,
+            "torch_state": {"buffers": buffers, "rng": np.zeros((), np.uint32)},
+        }
+        return apply_fn, variables
     return apply_fn, params

@@ -110,12 +110,27 @@ def get_max_memory(max_memory: dict | None = None) -> dict[str, int]:
     return out
 
 
-def get_balanced_memory(params: Any, num_devices: int | None = None) -> dict[str, int]:
-    """Even split of the model across devices (reference `get_balanced_memory`)."""
-    total, _ = calculate_maximum_sizes(params)
+def get_balanced_memory(
+    params: Any,
+    num_devices: int | None = None,
+    no_split_module_classes: Any = None,
+    low_zero: bool = False,
+) -> dict[str, int]:
+    """Per-device budgets that spread the model evenly (reference
+    `get_balanced_memory`, `modeling.py:951`): each device gets at least the
+    largest indivisible block (else the fit degenerates to first-fill), and
+    ``low_zero`` reserves device 0 for activations/generation by halving its
+    share, as the reference does for generate-heavy workloads."""
+    total, (largest_leaf, _) = calculate_maximum_sizes(params)
+    sizes = compute_module_sizes(params)
+    top_blocks = [v for k, v in sizes.items() if k and "/" not in k]
+    largest_block = max(top_blocks, default=largest_leaf)
     n = num_devices or len(jax.local_devices())
-    per = int(total / n * 1.1)
+    per = max(-(-total // n), largest_block)
+    per = int(per * 1.1)  # fit slack, as in the reference's buffer margin
     budget = {f"device:{i}": per for i in range(n)}
+    if low_zero and n > 1:
+        budget["device:0"] = per // 2
     budget["cpu"] = get_max_memory()["cpu"]
     budget["disk"] = 1 << 62
     return budget

@@ -1,0 +1,270 @@
+"""safetensors interchange — torch-free, both directions.
+
+Capability parity:
+  - export: reference `Accelerator.save_model` (`accelerator.py:2804-2919`) —
+    sharded ``.safetensors`` + ``model.safetensors.index.json`` with tied-weight
+    deduplication and a ``total_size`` header.
+  - import: reference `load_checkpoint_in_model` / safetensors device-direct
+    read (`utils/modeling.py:1611-1834`, `:1425-1518`) — stream HF sharded
+    safetensors checkpoints into a numpy state dict WITHOUT torch, ready for
+    the per-architecture ``params_from_hf_*`` mappers or direct pytree reshape.
+
+TPU-native notes: exported keys are "."-joined flat paths (the HF ecosystem
+convention) so files round-trip through `safetensors.numpy` and load in
+`transformers` unchanged; bfloat16 leaves are written natively (safetensors
+has first-class BF16; numpy doesn't, so bf16 crosses via ml_dtypes' view).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+
+
+def _flatten_leaves(tree: Any, sep: str = ".") -> dict[str, Any]:
+    """Nested pytree -> flat {dotted_key: ORIGINAL leaf} (no host conversion —
+    aliasing between leaves must survive for tied-weight detection)."""
+    flat: dict[str, Any] = {}
+
+    def _walk(node: Any, prefix: str) -> None:
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                _walk(v, f"{prefix}{sep}{k}" if prefix else str(k))
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                _walk(v, f"{prefix}{sep}{i}" if prefix else str(i))
+        elif node is None:
+            return
+        else:
+            flat[prefix] = node
+
+    _walk(tree, "")
+    return flat
+
+
+def flatten_state_dict(tree: Any, sep: str = ".") -> dict[str, np.ndarray]:
+    """Nested pytree -> flat {dotted_key: numpy array}."""
+    return {k: np.asarray(jax.device_get(v)) for k, v in _flatten_leaves(tree, sep).items()}
+
+
+def unflatten_state_dict(flat: Mapping[str, Any], sep: str = ".") -> dict:
+    """Flat {dotted_key: array} -> nested dict pytree."""
+    out: dict = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def _parse_size(size: str | int) -> int:
+    if isinstance(size, int):
+        return size
+    m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([KMGT]?B)\s*", size, re.IGNORECASE)
+    if not m:
+        raise ValueError(f"Unparseable max_shard_size {size!r}")
+    mult = {"B": 1, "KB": 10**3, "MB": 10**6, "GB": 10**9, "TB": 10**12}
+    return int(float(m.group(1)) * mult[m.group(2).upper()])
+
+
+def find_tied_weights(flat: Mapping[str, Any]) -> dict[str, str]:
+    """{alias_key: canonical_key} for entries that are the SAME view of the
+    same buffer (reference `find_tied_parameters`, `utils/modeling.py:605`).
+
+    Must run on ORIGINAL leaves: numpy views key on (data pointer, shape,
+    strides, dtype) — two DIFFERENT views of one buffer (q/k/v slices of a
+    fused qkv) are NOT tied, deduplicating them would corrupt the checkpoint —
+    and device arrays (jax.Array) key on object identity, since device_get
+    would copy each path into a distinct host buffer and erase the aliasing.
+    First occurrence is canonical."""
+    seen: dict[tuple, str] = {}
+    tied: dict[str, str] = {}
+    for k, v in flat.items():
+        if isinstance(v, np.ndarray):
+            ident = (v.__array_interface__["data"][0], v.shape, v.strides, str(v.dtype))
+        else:
+            ident = (id(v), getattr(v, "shape", None), None, str(getattr(v, "dtype", "")))
+        if ident in seen:
+            tied[k] = seen[ident]
+        else:
+            seen[ident] = k
+    return tied
+
+
+def save_safetensors_checkpoint(
+    state_dict: Any,
+    save_directory: str | os.PathLike,
+    max_shard_size: str | int = "10GB",
+    metadata: dict[str, str] | None = None,
+) -> list[str]:
+    """Write a (possibly nested) state dict as sharded safetensors with an HF
+    index. Returns the list of files written. Tied (aliased) tensors are saved
+    once and recorded under ``metadata.tied_weights`` in the index, mirroring
+    the reference's duplicate removal (`accelerator.py:2846-2880`)."""
+    from safetensors.numpy import save_file
+
+    save_directory = Path(save_directory)
+    save_directory.mkdir(parents=True, exist_ok=True)
+    raw = dict(state_dict) if _is_flat(state_dict) else _flatten_leaves(state_dict)
+    tied = find_tied_weights(raw)  # on ORIGINAL leaves, before host copies
+    flat = {k: _to_numpy(v) for k, v in raw.items() if k not in tied}
+
+    limit = _parse_size(max_shard_size)
+    shards: list[dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for k, v in flat.items():
+        nbytes = v.nbytes
+        if sizes[-1] + nbytes > limit and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][k] = v
+        sizes[-1] += nbytes
+
+    written: list[str] = []
+    base_meta = dict(metadata or {})
+    if tied:
+        base_meta["tied_weights"] = json.dumps(tied)
+    if len(shards) == 1:
+        path = save_directory / SAFE_WEIGHTS_NAME
+        save_file(shards[0], str(path), metadata={"format": "np", **base_meta})
+        return [str(path)]
+
+    n = len(shards)
+    weight_map: dict[str, str] = {}
+    for i, shard in enumerate(shards):
+        name = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        save_file(shard, str(save_directory / name), metadata={"format": "np", **base_meta})
+        written.append(str(save_directory / name))
+        for k in shard:
+            weight_map[k] = name
+    index = {
+        "metadata": {"total_size": int(sum(sizes)), **base_meta},
+        "weight_map": weight_map,
+    }
+    index_path = save_directory / SAFE_WEIGHTS_INDEX_NAME
+    index_path.write_text(json.dumps(index, indent=2, sort_keys=True))
+    written.append(str(index_path))
+    return written
+
+
+def load_safetensors_checkpoint(
+    checkpoint: str | os.PathLike,
+    *,
+    nested: bool = False,
+    dtype: Any = None,
+) -> dict[str, Any]:
+    """Stream a safetensors checkpoint (single file, sharded dir with index, or
+    HF model dir) into a flat numpy state dict — no torch anywhere. Tied
+    aliases recorded by `save_safetensors_checkpoint` are re-materialized as
+    references to the canonical array. ``nested=True`` returns the dotted keys
+    unflattened into a pytree; ``dtype`` optionally casts floating leaves."""
+    path = Path(checkpoint)
+    files: list[Path]
+    tied: dict[str, str] = {}
+    if path.is_file():
+        files = [path]
+    elif (path / SAFE_WEIGHTS_INDEX_NAME).exists():
+        index = json.loads((path / SAFE_WEIGHTS_INDEX_NAME).read_text())
+        files = [path / name for name in sorted(set(index["weight_map"].values()))]
+        if "tied_weights" in index.get("metadata", {}):
+            tied = json.loads(index["metadata"]["tied_weights"])
+    elif (path / SAFE_WEIGHTS_NAME).exists():
+        files = [path / SAFE_WEIGHTS_NAME]
+    else:
+        found = sorted(path.glob("*.safetensors")) if path.is_dir() else []
+        if not found:
+            raise FileNotFoundError(f"No safetensors checkpoint at {checkpoint}")
+        files = found
+
+    flat: dict[str, Any] = {}
+    for f in files:
+        flat.update(_load_one(f, dtype))
+        if not tied:
+            meta = _read_metadata(f)
+            if "tied_weights" in meta:
+                tied = json.loads(meta["tied_weights"])
+    for alias, canonical in tied.items():
+        if canonical in flat:
+            flat[alias] = flat[canonical]
+    return unflatten_state_dict(flat) if nested else flat
+
+
+def load_checkpoint_in_model(
+    model: Any,
+    checkpoint: str | os.PathLike,
+    mapper: Callable[[dict], dict] | None = None,
+    strict: bool = True,
+) -> Any:
+    """Load a safetensors checkpoint into a prepared model / param pytree
+    (role of reference `load_checkpoint_in_model`, `utils/modeling.py:1611`).
+
+    ``model`` may be a PreparedModel (params replaced in place, resharded by
+    its plan) or a plain param pytree (returns the new pytree). ``mapper``
+    adapts foreign layouts — e.g. ``params_from_hf_gpt2`` consuming the flat
+    HF state dict this loader produces.
+    """
+    flat = load_safetensors_checkpoint(checkpoint)
+    params = mapper(flat) if mapper is not None else unflatten_state_dict(flat)
+    if hasattr(model, "load_state_dict"):  # PreparedModel
+        if strict:
+            _check_structure(model.params, params)
+        model.load_state_dict(params)
+        return model
+    if strict and hasattr(model, "keys"):
+        _check_structure(model, params)
+    return params
+
+
+# ----------------------------------------------------------------- internals
+def _is_flat(tree: Any) -> bool:
+    return isinstance(tree, Mapping) and all(
+        not isinstance(v, (Mapping, list, tuple)) for v in tree.values()
+    )
+
+
+def _to_numpy(v: Any) -> np.ndarray:
+    # bf16 leaves arrive as ml_dtypes bfloat16 arrays, which safetensors
+    # writes natively — no special-casing needed
+    return np.asarray(jax.device_get(v))
+
+
+def _load_one(path: Path, dtype: Any) -> dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    out: dict[str, np.ndarray] = {}
+    with safe_open(str(path), framework="np") as f:
+        for k in f.keys():
+            arr = f.get_tensor(k)
+            if dtype is not None and np.issubdtype(np.asarray(arr).dtype, np.floating):
+                arr = np.asarray(arr).astype(dtype)
+            out[k] = arr
+    return out
+
+
+def _read_metadata(path: Path) -> dict[str, str]:
+    from safetensors import safe_open
+
+    with safe_open(str(path), framework="np") as f:
+        return dict(f.metadata() or {})
+
+
+def _check_structure(expected: Any, got: Any) -> None:
+    exp = set(flatten_state_dict(expected).keys())
+    new = set(flatten_state_dict(got).keys())
+    missing, unexpected = exp - new, new - exp
+    if missing or unexpected:
+        raise ValueError(
+            f"Checkpoint structure mismatch: missing={sorted(missing)[:8]} "
+            f"unexpected={sorted(unexpected)[:8]}"
+        )

@@ -11,6 +11,8 @@ Env knobs (all optional):
   BENCH_ATTN        flash | xla           attention implementation
   BENCH_SCAN=1      lax.scan over layers (faster compile, one compiled block)
   BENCH_REMAT       full | dots | dots_no_batch   remat policy (default off)
+  BENCH_FUSED_CE=1  fused head+chunked cross-entropy (no full-logits tensor)
+  BENCH_CE_CHUNK    fused-CE row-chunk size (default 1024)
   BENCH_PREFETCH=1  feed batches through the native C++ staging ring
   BENCH_TIMEOUT     watchdog seconds (default 540): if the device never
                     responds (e.g. dead TPU tunnel), print an error JSON line
@@ -125,7 +127,7 @@ def main() -> None:
     import optax
 
     from accelerate_tpu.accelerator import Accelerator
-    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn, lm_loss_fn_fused
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
@@ -151,7 +153,14 @@ def main() -> None:
     state["stage"] = "init_params"
     params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
     model, opt = acc.prepare((module, params), optax.adamw(1e-4))
-    step = acc.make_train_step(lm_loss_fn)
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
+    if fused_ce:
+        import functools
+
+        loss_fn = functools.partial(lm_loss_fn_fused, chunk=_env_int("BENCH_CE_CHUNK", 1024))
+    else:
+        loss_fn = lm_loss_fn
+    step = acc.make_train_step(loss_fn)
 
     ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     if os.environ.get("BENCH_PREFETCH", "0") == "1":
@@ -201,6 +210,7 @@ def main() -> None:
             "attn": attn,
             "scan": scan,
             "remat": remat or "off",
+            "fused_ce": fused_ce,
             "platform": jax.devices()[0].platform,
             "loss": round(final_loss, 4),
         },

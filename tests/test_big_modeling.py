@@ -77,20 +77,112 @@ def test_find_tied_parameters():
 
 
 def test_infer_auto_device_map_tiers(tiny_gpt2):
+    """gpt2 blockwise layout: embed/head share the tied wte, so they form ONE
+    placement unit whose size counts wte once — they land on the same tier."""
     _, _, params, _, _ = tiny_gpt2
     sd = gpt2_blockwise_state_dict(params)
     sizes = compute_module_sizes(sd)
-    # budget: only the embed block fits on device, one block on cpu, rest disk
+    wte = sizes["embed/wte"]
+    unit = sizes["embed"] + sizes["head"] - wte  # tied buffer counted once
     budget = {
-        "device:0": sizes["embed"] + 1,
+        "device:0": unit + 1,
         "cpu": sizes["block_0"] + 1,
         "disk": 1 << 62,
     }
-    dmap = infer_auto_device_map(sd, max_memory=budget)
-    assert dmap["embed"] == "device"
+    no_split = ["embed", "head", "block_"]
+    dmap = infer_auto_device_map(sd, max_memory=budget, no_split_module_classes=no_split)
+    assert dmap["embed"] == "device:0"
+    assert dmap["head"] == "device:0"  # tied to embed -> same tier
     assert dmap["block_0"] == "cpu"
     assert dmap["block_1"] == "disk"
-    assert dmap["head"] == "disk"
+
+
+class TestDeviceMapSolver:
+    """Solver-shaped cases mirroring reference tests/test_modeling_utils.py
+    against `utils/modeling.py:1096-1398`."""
+
+    def _params(self, a=100, b=100, c=100):
+        return {
+            "a": {"w": np.zeros((a,), np.float32)},
+            "b": {"w": np.zeros((b,), np.float32)},
+            "c": {"w": np.zeros((c,), np.float32)},
+        }
+
+    def test_per_device_budgets_fill_in_order(self):
+        p = self._params()
+        dmap = infer_auto_device_map(
+            p, max_memory={"device:0": 450, "device:1": 450, "cpu": 10_000}
+        )
+        assert dmap == {"a": "device:0", "b": "device:1", "c": "cpu"}
+
+    def test_oversized_block_splits_into_children(self):
+        p = {"big": {"x": np.zeros(100, np.float32), "y": np.zeros(100, np.float32)},
+             "small": {"w": np.zeros(10, np.float32)}}
+        dmap = infer_auto_device_map(
+            p, max_memory={"device:0": 450, "cpu": 10_000}, clean_result=False
+        )
+        # 800B block doesn't fit; children re-fitted individually. Once y
+        # spills to cpu the cursor never moves back (execution order), so
+        # small lands on cpu too — no backfill onto device:0.
+        assert dmap["big/x"] == "device:0"
+        assert dmap["big/y"] == "cpu"
+        assert dmap["small"] == "cpu"
+
+    def test_no_backfill_preserves_execution_order(self):
+        p = {"a": {"w": np.zeros(100, np.float32)},
+             "b": {"w": np.zeros(100, np.float32)},
+             "c": {"w": np.zeros(10, np.float32)}}
+        dmap = infer_auto_device_map(
+            p, max_memory={"device:0": 450, "device:1": 1000, "cpu": 10_000}
+        )
+        # c executes after b; it must not land on an earlier device than b
+        assert dmap == {"a": "device:0", "b": "device:1", "c": "device:1"}
+
+    def test_no_split_moves_whole_block(self):
+        p = {"big": {"x": np.zeros(100, np.float32), "y": np.zeros(100, np.float32)},
+             "small": {"w": np.zeros(10, np.float32)}}
+        dmap = infer_auto_device_map(
+            p, max_memory={"device:0": 450, "cpu": 10_000},
+            no_split_module_classes=["big"],
+        )
+        assert dmap["big"] == "cpu"
+        # small executes after big: no backfill onto device:0
+        assert dmap["small"] == "cpu"
+
+    def test_tied_blocks_fused_and_size_counted_once(self):
+        shared = np.zeros(100, np.float32)  # 400B, aliased in a and c
+        p = {"a": {"w": shared}, "b": {"w": np.zeros(100, np.float32)}, "c": {"w": shared}}
+        # unit(a, c) is 400B physical (not 800): fits a 450B device with b evicted
+        dmap = infer_auto_device_map(p, max_memory={"device:0": 450, "cpu": 10_000})
+        assert dmap["a"] == "device:0"
+        assert dmap["c"] == "device:0"
+        assert dmap["b"] == "cpu"
+
+    def test_clean_device_map_merges_uniform_children(self):
+        from accelerate_tpu.big_modeling import clean_device_map
+
+        merged = clean_device_map({"m/x": "cpu", "m/y": "cpu", "n": "device:0"})
+        assert merged == {"m": "cpu", "n": "device:0"}
+
+    def test_balanced_memory_covers_largest_block(self):
+        from accelerate_tpu.utils.modeling import get_balanced_memory
+
+        p = {"big": {"w": np.zeros(1000, np.float32)}, "s": {"w": np.zeros(10, np.float32)}}
+        budget = get_balanced_memory(p, num_devices=4)
+        # every device gets at least the largest indivisible block
+        assert all(budget[f"device:{i}"] >= 4000 for i in range(4))
+        low = get_balanced_memory(p, num_devices=4, low_zero=True)
+        assert low["device:0"] < low["device:1"]
+
+    def test_balanced_budget_spreads_blocks(self):
+        from accelerate_tpu.utils.modeling import get_balanced_memory
+
+        p = {f"l{i}": {"w": np.zeros(100, np.float32)} for i in range(4)}
+        budget = get_balanced_memory(p, num_devices=2)
+        budget.pop("cpu"), budget.pop("disk")
+        dmap = infer_auto_device_map(p, max_memory={**budget, "cpu": 1 << 40})
+        used = {v for k, v in dmap.items()}
+        assert used == {"device:0", "device:1"}  # both devices actually used
 
 
 @pytest.mark.parametrize("mode", ["device", "cpu", "disk", "mixed"])
@@ -111,6 +203,29 @@ def test_blockwise_dispatch_matches_full(tiny_gpt2, tmp_path, mode):
     bw = dispatch_model(bw, dmap, sd, offload_dir=str(tmp_path / "offload"))
     out = bw(ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_split_block_device_map_dispatch(tiny_gpt2, tmp_path):
+    """A solver-split block (nested device_map keys straddling tiers) must be
+    assembled transparently by dispatch + BlockwiseModel, and the model must
+    survive repeated calls (resident parts not evicted)."""
+    cfg, module, params, ids, ref = tiny_gpt2
+    bw = gpt2_blockwise(cfg)
+    sd = gpt2_blockwise_state_dict(params)
+    dmap = {n: "device" for n, _ in bw.block_fns}
+    del dmap["block_1"]
+    dmap.update({
+        "block_1/ln_1": "device:0",
+        "block_1/ln_2": "cpu",
+        "block_1/attn": "cpu",
+        "block_1/mlp": "disk",
+    })
+    from accelerate_tpu.big_modeling import dispatch_model
+
+    bw = dispatch_model(bw, dmap, sd, offload_dir=str(tmp_path / "off"))
+    for _ in range(2):  # second call: resident parts must still be alive
+        out = bw(ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
 def test_cpu_and_disk_offload_helpers(tiny_gpt2, tmp_path):
