@@ -314,7 +314,14 @@ def dispatch_model(
             if sharding is not None:
                 target = sharding
             elif ":" in tier:
-                target = local[min(int(tier.split(":")[1]), len(local) - 1)]
+                idx = int(tier.split(":")[1])
+                if idx >= len(local):
+                    raise ValueError(
+                        f"device_map entry {name!r} -> {tier!r} but only "
+                        f"{len(local)} local devices exist — the map was solved "
+                        "for a different topology; re-run infer_auto_device_map."
+                    )
+                target = local[idx]
             else:
                 target = None
             placed[name] = jax.tree.map(

@@ -25,6 +25,44 @@ def default_config_file() -> Path:
     return Path(base) / DEFAULT_CONFIG_NAME
 
 
+CONFIG_VERSION = 1
+
+# Older / HF-accelerate config files load transparently: key renames applied
+# on read (reference `config_utils.py` config versioning + `config update`).
+_LEGACY_KEYS = {
+    "num_machines": "num_processes",
+    "machine_rank": "process_id",
+    "debug_mode": "debug",
+}
+
+
+def _migrate_legacy(data: dict) -> dict:
+    out = dict(data)
+    # HF configs carry BOTH num_machines (hosts) and num_processes (total GPUs);
+    # here a "process" is a host, so num_machines wins unconditionally
+    if "num_machines" in out:
+        out.pop("num_processes", None)
+    for old, new in _LEGACY_KEYS.items():
+        if old in out and new not in out:
+            out[new] = out.pop(old)
+    # reference-style coordinator: main_process_ip + main_process_port
+    ip, port = out.pop("main_process_ip", None), out.pop("main_process_port", None)
+    if ip and "coordinator_address" not in out:
+        out["coordinator_address"] = f"{ip}:{port or 29500}"
+    # reference distributed_type hints map onto mesh degrees
+    dist = str(out.pop("distributed_type", "")).upper()
+    if dist == "FSDP" and "fsdp_size" not in out:
+        out["fsdp_size"] = -1
+        out.setdefault("data_parallel_size", 1)
+    if dist == "MEGATRON_LM":
+        mega = out.pop("megatron_lm_config", {}) or {}
+        out.setdefault("tensor_size", int(mega.get("megatron_lm_tp_degree", 1)))
+        out.setdefault("stage_size", int(mega.get("megatron_lm_pp_degree", 1)))
+    if str(out.get("mixed_precision", "")).lower() in ("", "none"):
+        out["mixed_precision"] = "no"
+    return out
+
+
 @dataclass
 class LaunchConfig:
     """Everything the launcher needs to start a run (reference ClusterConfig)."""
@@ -46,7 +84,7 @@ class LaunchConfig:
         path = path or default_config_file()
         path.parent.mkdir(parents=True, exist_ok=True)
         with open(path, "w") as f:
-            yaml.safe_dump(asdict(self), f, sort_keys=False)
+            yaml.safe_dump({"config_version": CONFIG_VERSION, **asdict(self)}, f, sort_keys=False)
         return path
 
     @classmethod
@@ -56,6 +94,8 @@ class LaunchConfig:
             return cls()
         with open(path) as f:
             data = yaml.safe_load(f) or {}
+        if data.get("config_version", 0) < CONFIG_VERSION:
+            data = _migrate_legacy(data)
         known = {k: v for k, v in data.items() if k in cls.__dataclass_fields__}
         return cls(**known)
 
@@ -99,9 +139,21 @@ def config_command(args: argparse.Namespace) -> None:
     print(f"Configuration saved to {path}")
 
 
+def update_command(args: argparse.Namespace) -> None:
+    """Rewrite an old (or HF-accelerate) config in the current schema
+    (reference `accelerate config update`)."""
+    src = Path(args.config_file) if args.config_file else default_config_file()
+    cfg = LaunchConfig.from_yaml(src)
+    path = cfg.to_yaml(src)
+    print(f"Rewrote {path} at config_version={CONFIG_VERSION}")
+
+
 def add_parser(subparsers) -> None:
     p = subparsers.add_parser("config", help="create the launch configuration interactively")
     p.add_argument("--config_file", default=None, help="where to save the YAML")
     p.add_argument("--default", action="store_true", help="write defaults without prompting")
     p.add_argument("--mixed_precision", default="no")
     p.set_defaults(func=config_command)
+    u = subparsers.add_parser("config-update", help="migrate a config file to the current schema")
+    u.add_argument("--config_file", default=None)
+    u.set_defaults(func=update_command)

@@ -445,16 +445,37 @@ class DataLoaderShard:
     # ----------------------------------------------------- checkpoint support
     def state_dict(self) -> dict[str, Any]:
         """Mid-epoch resumable state (reference StatefulDataLoader adapter,
-        `data_loader.py:401-483`)."""
-        return {
+        `data_loader.py:401-483`). When the wrapped loader is itself stateful
+        (torchdata StatefulDataLoader), its snapshot — including worker /
+        prefetched-batch state — is carried verbatim; the synchronized
+        sampler's RNG state rides along so shuffling resumes identically."""
+        state = {
             "iteration": self.iteration,
             "batches_seen_in_epoch": self.batches_seen_in_epoch,
             "end_of_dataloader": self.end_of_dataloader,
         }
+        if hasattr(self.base_loader, "state_dict"):
+            try:
+                state["base_loader"] = self.base_loader.state_dict()
+            except Exception:
+                pass
+        sampler = self.synchronized_generator
+        if sampler is not None and hasattr(sampler, "epoch"):
+            state["sampler_epoch"] = sampler.epoch
+        return state
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
         self.iteration = state["iteration"]
         self.set_epoch(self.iteration)
+        if "base_loader" in state and hasattr(self.base_loader, "load_state_dict"):
+            try:
+                self.base_loader.load_state_dict(state["base_loader"])
+                return  # the base loader resumes mid-epoch itself: no re-skip
+            except Exception:
+                pass
+        if "sampler_epoch" in state and self.synchronized_generator is not None:
+            if hasattr(self.synchronized_generator, "set_epoch"):
+                self.synchronized_generator.set_epoch(state["sampler_epoch"])
         if not state.get("end_of_dataloader", False):
             self.skip_batches = state.get("batches_seen_in_epoch", 0)
 
@@ -491,18 +512,23 @@ class DataLoaderDispatcher(DataLoaderShard):
 
                 source = iter(self.base_loader)
                 if self._drop_last:
-                    # drop SHORT batches before the last-batch lookahead, so
-                    # `last` lands on a batch that is actually yielded (the
-                    # epoch-end sync boundary must be observed)
+                    # drop ONLY a trailing short batch, before the last-batch
+                    # lookahead, so `last` lands on a batch that is actually
+                    # yielded (the epoch-end sync boundary must be observed);
+                    # mid-epoch size variation (bucketed samplers) passes through
                     def _full_only(it):
                         first_bs = None
+                        prev = None
                         for b in it:
-                            bs = find_batch_size(b)
+                            if prev is not None:
+                                yield prev
                             if first_bs is None:
-                                first_bs = bs
-                            if bs is not None and first_bs is not None and bs < first_bs:
-                                continue
-                            yield b
+                                first_bs = find_batch_size(b)
+                            prev = b
+                        if prev is not None:
+                            bs = find_batch_size(prev)
+                            if not (bs is not None and first_bs is not None and bs < first_bs):
+                                yield prev
 
                     source = _full_only(source)
                 base_it = _PrefetchIterator(source, _mark_last)

@@ -29,11 +29,37 @@ def notebook_launcher(
     num_processes: int | None = None,
     mixed_precision: str = "no",
     use_port: str = "29500",
+    max_restarts: int = 0,
     **kwargs,
 ) -> None:
-    """Run ``function(*args)`` on this host's devices (reference `launchers.py:40`)."""
+    """Start training from a notebook (reference `launchers.py:40-266`).
+
+    On a TPU VM every local chip is already attached to THIS process, so the
+    single-host case needs no elastic worker spawn: the function runs inline
+    over all devices (the reference's per-core xmp.spawn is a torch_xla
+    artifact). Passing ``num_processes`` > 1 forks that many real JAX
+    processes over a localhost coordinator — the reference's multi-worker
+    notebook path, realized with the same process machinery as
+    `debug_launcher` but on the default platform; ``max_restarts`` re-runs a
+    crashed generation, mirroring the reference's elastic agent restarts.
+    """
     os.environ.setdefault("ACCELERATE_TPU_MIXED_PRECISION", mixed_precision)
-    function(*args)
+    if num_processes is None or num_processes <= 1:
+        function(*args)
+        return
+    if os.environ.get("ACCELERATE_TPU_NUM_PROCESSES"):
+        raise RuntimeError(
+            "notebook_launcher cannot nest inside an already-launched distributed job."
+        )
+    attempt = 0
+    while True:
+        try:
+            debug_launcher(function, args=args, num_processes=num_processes, platform=None)
+            return
+        except RuntimeError:
+            if attempt >= max_restarts:
+                raise
+            attempt += 1
 
 
 def debug_launcher(
@@ -41,11 +67,15 @@ def debug_launcher(
     args: tuple = (),
     num_processes: int = 2,
     devices_per_process: int = 1,
+    platform: str | None = "cpu",
 ) -> None:
-    """Fork ``num_processes`` CPU 'hosts' over a localhost coordinator and run
+    """Fork ``num_processes`` 'hosts' over a localhost coordinator and run
     ``function(*args)`` in each (reference `launchers.py:269` — 2-proc gloo CPU).
 
-    ``devices_per_process`` > 1 gives each child that many virtual CPU devices
+    ``platform="cpu"`` (the default, the debug tier) forces each child onto the
+    host-CPU backend; ``platform=None`` inherits the parent's platform — used
+    by `notebook_launcher` so notebook-spawned workers keep their accelerator.
+    ``devices_per_process`` > 1 gives each CPU child that many virtual devices
     (host-platform multiplexing) — a pod-slice topology (N hosts × M chips)
     without hardware.
 
@@ -78,14 +108,16 @@ def debug_launcher(
         env = dict(os.environ)
         env.update(
             {
-                "JAX_PLATFORMS": "cpu",
-                "PALLAS_AXON_POOL_IPS": "",
                 "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
                 "JAX_NUM_PROCESSES": str(num_processes),
                 "JAX_PROCESS_ID": str(i),
                 "ACCELERATE_TPU_NUM_PROCESSES": str(num_processes),
             }
         )
+        if platform is not None:
+            env["JAX_PLATFORMS"] = platform
+            if platform == "cpu":
+                env["PALLAS_AXON_POOL_IPS"] = ""
         if devices_per_process > 1:
             flags = [
                 f for f in env.get("XLA_FLAGS", "").split()

@@ -75,8 +75,8 @@ def attention(
     causal: bool = False,
     mask: jax.Array | None = None,
     implementation: str = "auto",
-    block_q: int = 512,
-    block_kv: int = 512,
+    block_q: int | None = None,
+    block_kv: int | None = None,
 ) -> jax.Array:
     """Dispatching entry point: 'xla' | 'flash' | 'auto'.
 

@@ -18,7 +18,13 @@ Design (TPU-idiomatic, per /opt/skills/guides/pallas_guide.md):
 
 Layouts are [batch, seq, heads, head_dim] at the API, transposed to
 [batch, heads, seq, head_dim] internally so each (b, h) grid cell addresses a
-contiguous [seq, head_dim] tile. Head dims are zero-padded to the 128-lane width.
+contiguous [seq, head_dim] tile. Head dims stay NATIVE (64 for GPT-2-class
+models): Mosaic lane-pads tiles in VMEM but the HBM traffic is the real 64
+columns — the round-2 kernel zero-padded to 128 in HBM, which doubled every
+Q/K/V/dO tensor's bytes AND the dot FLOPs (profiled at 30% of the train step).
+The per-row log-sum-exp / delta tensors use an 8-lane broadcast [b, h, s, 8]
+(the narrowest layout Mosaic tiles) instead of the previous 128-lane broadcast
+— 1/16 the fp32 bytes (50 MB -> 3 MB per tensor at bench shapes).
 """
 
 from __future__ import annotations
@@ -81,7 +87,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, causa
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        # lse stored lane-broadcast [block_q, 128] to satisfy TPU tiling
         lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(safe_l), lse_ref.shape[2:])
 
 
@@ -103,11 +108,11 @@ def _fwd(q, k, v, causal, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -136,7 +141,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, 
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, :1]  # [block_q, 1] (lane-broadcast storage)
+        lse = lse_ref[0, 0][:, :1]  # [block_q, 1] (8-lane broadcast storage)
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
@@ -201,7 +206,7 @@ def _bwd(causal, block_q, block_kv, interpret, residuals, dout):
     skv = k.shape[2]
     nq, nkv = sq // block_q, skv // block_kv
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 128))  # lane-broadcast
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))  # 8-lane broadcast
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, block_q=block_q, block_kv=block_kv, nkv=nkv),
@@ -211,8 +216,8 @@ def _bwd(causal, block_q, block_kv, interpret, residuals, dout):
             pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -228,8 +233,8 @@ def _bwd(causal, block_q, block_kv, interpret, residuals, dout):
             pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
@@ -267,6 +272,12 @@ def _flash_bwd(causal, block_q, block_kv, interpret, residuals, dout):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(name: str, default: int) -> int:
+    import os
+
+    return int(os.environ.get(name, default))
+
+
 def flash_attention(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,
@@ -274,29 +285,38 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: float | None = None,
-    block_q: int = 512,
-    block_kv: int = 512,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] inputs.
 
-    Sequence lengths must divide the (auto-shrunk) block sizes; head_dim is
-    zero-padded to a multiple of 128 lanes internally.
+    Sequence lengths must divide the (auto-shrunk) block sizes. head_dim is
+    used NATIVELY when it is a multiple of the 8-sublane width (64 for GPT-2
+    class models — Mosaic lane-pads in VMEM, HBM moves only real bytes);
+    other head dims are zero-padded up to the next multiple of 128.
     """
     b, sq, hn, d = q.shape
     skv = k.shape[1]
     if interpret is None:
         interpret = not _on_tpu()
     scale = 1.0 / math.sqrt(d) if scale is None else scale
+    # Block defaults are env-tunable for sweeps (ACCELERATE_TPU_FLASH_BLOCK_*).
+    # 1024×1024 won the round-3 sweep (docs/PERF_NOTES.md): at s<=1024 the whole
+    # (b,h) attention runs in ONE grid cell, and the [block_q, block_kv] fp32
+    # logits tile (4 MB) still fits VMEM comfortably; longer sequences fall
+    # back to 1024-wide tiles.
+    block_q = _env_block("ACCELERATE_TPU_FLASH_BLOCK_Q", 1024) if block_q is None else block_q
+    block_kv = _env_block("ACCELERATE_TPU_FLASH_BLOCK_KV", 1024) if block_kv is None else block_kv
     block_q = min(block_q, sq)
     block_kv = min(block_kv, skv)
     if sq % block_q or skv % block_kv:
         raise ValueError(f"seq lengths ({sq}, {skv}) must divide block sizes ({block_q}, {block_kv})")
-    # transpose to [B, H, S, D]; pad head dim to lane width
+    # transpose to [B, H, S, D]
     qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    d_pad = (128 - d % 128) % 128
+    d_pad = 0 if d % 64 == 0 else (128 - d % 128) % 128
     if d_pad:
         pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
         qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)

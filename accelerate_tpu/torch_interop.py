@@ -405,7 +405,12 @@ def convert_torch_module(
         def next_dropout_key():
             rng_box["calls"] += 1
             if rng_box["key"] is None:
-                return None
+                raise RuntimeError(
+                    "This module was converted with train=True and contains active "
+                    "Dropout: call apply_fn(params, *args, extra_state=...) with the "
+                    "'torch_state' collection (Accelerator.prepare threads it "
+                    "automatically), or re-convert with train=False for inference."
+                )
             return jax.random.fold_in(rng_box["key"], rng_box["calls"])
 
         def lookup(prefix: str, store: dict) -> dict:

@@ -260,8 +260,9 @@ def _read_metadata(path: Path) -> dict[str, str]:
 
 
 def _check_structure(expected: Any, got: Any) -> None:
-    exp = set(flatten_state_dict(expected).keys())
-    new = set(flatten_state_dict(got).keys())
+    # key-set comparison only: _flatten_leaves never device_gets the weights
+    exp = set(_flatten_leaves(expected).keys())
+    new = set(_flatten_leaves(got).keys())
     missing, unexpected = exp - new, new - exp
     if missing or unexpected:
         raise ValueError(

@@ -214,3 +214,31 @@ def test_dispatcher_script_multidevice():
     finally:
         os.environ.clear()
         os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_notebook_launcher_multiprocess():
+    """notebook_launcher(num_processes=2) forks real JAX workers (reference
+    launchers.py:40-266 multi-worker notebook path)."""
+    from accelerate_tpu.launchers import notebook_launcher
+    from accelerate_tpu.test_utils.scripts import test_multiprocess_ops
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    # workers inherit the parent platform (that's the point of the notebook
+    # path); pin it to cpu for the test host
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    try:
+        notebook_launcher(test_multiprocess_ops.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def test_notebook_launcher_rejects_nesting(monkeypatch):
+    from accelerate_tpu.launchers import notebook_launcher
+
+    monkeypatch.setenv("ACCELERATE_TPU_NUM_PROCESSES", "2")
+    with pytest.raises(RuntimeError, match="nest"):
+        notebook_launcher(lambda: None, num_processes=2)

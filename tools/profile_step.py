@@ -98,8 +98,6 @@ def _top_ops(rows, n: int = 25):
     """Reduce the framework-op-stats table to the top-N self-time entries."""
     if isinstance(rows, dict):
         rows = rows.get("data", rows)
-    if isinstance(rows, list) and rows and isinstance(rows[0], dict) and "p" in str(rows[0])[:200]:
-        pass
     return rows[:n] if isinstance(rows, list) else rows
 
 
