@@ -39,7 +39,7 @@ from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, DistributedType, GradientState, PartialState
 from .utils import operations
 from .utils.operations import convert_to_fp32, recursively_apply
-from .utils.precision import DynamicGradScaler, PrecisionPolicy
+from .utils.precision import DynamicGradScaler, GradScalerState, PrecisionPolicy
 from .utils.random import split_rng_key
 
 
@@ -888,22 +888,28 @@ class Accelerator:
                     )
                 n_replicas = mesh.shape["data"]
 
-        def loss_and_grads(params, mstate, batch):
+        scaler = optimizer.scaler if optimizer is not None else None
+
+        def loss_and_grads(params, mstate, batch, inner):
             # mstate = mutable non-param collections (batch_stats/fp8_meta/…),
             # threaded through as value_and_grad aux — None for pure models.
+            # ``inner`` is the fp16 loss-scale factor applied INSIDE the
+            # reduced-precision backward (see DynamicGradScaler.split_scale);
+            # 1.0 when no scaler is active.
             def f(p):
                 bound = BoundModel(model.apply_fn, policy.cast_to_compute(p), mstate)
                 out = loss_fn(bound, batch)
                 loss = out[0] if isinstance(out, tuple) else out
-                return loss.astype(jnp.float32), bound.extra_state
+                loss = loss.astype(jnp.float32)
+                return loss * inner, (loss, bound.extra_state)
 
-            (loss, new_mstate), grads = jax.value_and_grad(f, has_aux=True)(params)
+            (_, (loss, new_mstate)), grads = jax.value_and_grad(f, has_aux=True)(params)
             return loss, grads, new_mstate
 
-        # lgr signature: (params, mstate, batch, comm_rep, comm_err) ->
+        # lgr signature: (params, mstate, batch, comm_rep, comm_err, inner) ->
         #                (loss, grads, mstate, comm_rep, comm_err)
-        def lgr_plain(params, mstate, batch, comm_rep, comm_err):
-            loss, grads, mstate = loss_and_grads(params, mstate, batch)
+        def lgr_plain(params, mstate, batch, comm_rep, comm_err, inner):
+            loss, grads, mstate = loss_and_grads(params, mstate, batch, inner)
             return loss, grads, mstate, comm_rep, comm_err
 
         lgr_hooked = None
@@ -911,11 +917,11 @@ class Accelerator:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            def _local(params, mstate, batch, comm_rep, comm_err):
+            def _local(params, mstate, batch, comm_rep, comm_err, inner):
                 # per-replica gradients; the only cross-replica traffic is the
                 # compressed reduction + scalar loss pmean. Error-feedback buffers
                 # (comm_err) stay worker-local: leading axis sharded over "data".
-                loss, grads, mstate = loss_and_grads(params, mstate, batch)
+                loss, grads, mstate = loss_and_grads(params, mstate, batch, inner)
                 grads, comm_rep, comm_err = reduce_gradients(
                     grads, comm_rep, comm_err, "data", hook_cfg
                 )
@@ -935,7 +941,7 @@ class Accelerator:
             lgr_hooked = shard_map(
                 _local,
                 mesh=mesh,
-                in_specs=(P(), P(), P("data"), P(), P("data")),
+                in_specs=(P(), P(), P("data"), P(), P("data"), P()),
                 out_specs=(P(), P(), P(), P(), P("data")),
                 check_vma=False,
             )
@@ -952,14 +958,24 @@ class Accelerator:
                 return tree
             return jax.tree.map(jax.lax.with_sharding_constraint, tree, param_shardings)
 
+        def _split(scaler_state):
+            # (inner loss-scale for the fp16 backward, its inverse factor) —
+            # derived INSIDE the jit from the threaded scaler state, so there is
+            # exactly one source of truth for both scaling and policy updates
+            if scaler is None or scaler_state is None:
+                return jnp.asarray(1.0, jnp.float32)
+            inner, _ = scaler.split_scale(scaler_state.scale)
+            return inner
+
         def make_micro(lgr):
             # acc / mstate / comm_err are consumed and replaced every call:
             # donating them keeps ONE gradient accumulator in HBM instead of
             # old+new copies during each microbatch.
             @functools.partial(jax.jit, donate_argnums=(1, 2, 5) if donate else ())
-            def micro_step(params, mstate, acc, batch, comm_rep, comm_err):
+            def micro_step(params, mstate, acc, batch, comm_rep, comm_err, scaler_state):
+                inner = _split(scaler_state)
                 loss, grads, mstate, comm_rep, comm_err = lgr(
-                    params, mstate, batch, comm_rep, comm_err
+                    params, mstate, batch, comm_rep, comm_err, inner
                 )
                 grads = constrain_like_params(grads)
                 acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
@@ -968,19 +984,33 @@ class Accelerator:
             return micro_step
 
         def make_update(lgr):
-            def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err, inv_k):
+            def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err, inv_k, scaler_state):
+                inner = _split(scaler_state)
                 loss, grads, mstate, comm_rep, comm_err = lgr(
-                    params, mstate, batch, comm_rep, comm_err
+                    params, mstate, batch, comm_rep, comm_err, inner
                 )
                 if acc is not None:
                     grads = jax.tree.map(jnp.add, acc, grads)
-                grads = jax.tree.map(lambda g: g * inv_k, grads)
+                # undo the inner loss scale and the accumulation factor in fp32
+                grads = jax.tree.map(lambda g: g * (inv_k / inner), grads)
                 grads = constrain_like_params(grads)
+                finite = jnp.asarray(True)
+                if scaler is not None:
+                    finite = scaler.all_finite(grads)
                 if max_grad_norm is not None:
                     grads, _ = _clip_tree(grads, max_grad_norm)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = constrain_like_params(optax.apply_updates(params, updates))
-                return params, opt_state, mstate, loss, comm_rep, comm_err
+                updates, new_opt_state = tx.update(grads, opt_state, params)
+                new_params = constrain_like_params(optax.apply_updates(params, updates))
+                if scaler is not None:
+                    # skip the update on overflow; torch-GradScaler growth/backoff
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_params, params
+                    )
+                    new_opt_state = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_opt_state, opt_state
+                    )
+                    scaler_state = scaler.update_state(scaler_state, finite)
+                return new_params, new_opt_state, mstate, loss, comm_rep, comm_err, scaler_state, finite
 
             return jax.jit(_update, donate_argnums=(0, 1, 2, 3, 6) if donate else ())
 
@@ -1002,7 +1032,10 @@ class Accelerator:
             if self.gradient_state.sync_gradients:
                 upd = update_hooked if hooked else update_plain
                 inv_k = jnp.asarray(1.0 / self.gradient_state.num_steps, dtype=jnp.float32)
-                params, opt_state, mstate, loss, state_box["rep"], state_box["err"] = upd(
+                (
+                    params, opt_state, mstate, loss,
+                    state_box["rep"], state_box["err"], new_scaler_state, finite,
+                ) = upd(
                     model.params,
                     optimizer.opt_state,
                     model.extra_state,
@@ -1011,10 +1044,22 @@ class Accelerator:
                     state_box["rep"],
                     state_box["err"],
                     inv_k,
+                    optimizer.scaler_state,
                 )
                 model.params = params
                 optimizer.opt_state = opt_state
                 model.extra_state = mstate
+                if scaler is not None:
+                    optimizer.scaler_state = new_scaler_state
+                    # lazy device scalars: reading (bool()/int()) syncs,
+                    # assigning doesn't — skipped boundaries never count as
+                    # applied updates (imperative-path semantics)
+                    optimizer.step_was_skipped = jnp.logical_not(finite)
+                    optimizer._skipped_updates = (
+                        optimizer._skipped_updates + jnp.logical_not(finite).astype(jnp.int32)
+                    )
+                # boundary count: drives comm-hook warmup; `num_updates`
+                # subtracts the device-tracked skips on read
                 optimizer._num_updates += 1
                 state_box["acc"] = None
                 state_box["count"] = 0
@@ -1028,6 +1073,7 @@ class Accelerator:
                         batch,
                         state_box["rep"],
                         state_box["err"],
+                        optimizer.scaler_state,
                     )
                 )
                 state_box["count"] += 1

@@ -2,7 +2,16 @@
 `utils/megatron_lm.py:446-864` — plus Llama/ResNet from the example suite)."""
 
 from .bert import BertConfig, BertForSequenceClassification, bert_sharding_rules
-from .gpt2 import GPT2Config, GPT2LMHead, gpt2_sharding_rules, lm_loss_fn, params_from_hf_gpt2
+from .gpt2 import (
+    GPT2Config,
+    GPT2LMHead,
+    chunked_cross_entropy,
+    gpt2_sharding_rules,
+    lm_loss_fn,
+    lm_loss_fn_fused,
+    lm_loss_fn_pallas,
+    params_from_hf_gpt2,
+)
 from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, llama_sharding_rules, params_from_hf_llama
 from .mixtral import (
     MixtralConfig,

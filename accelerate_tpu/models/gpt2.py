@@ -305,6 +305,23 @@ def lm_loss_fn_fused(model, batch, chunk: int = 1024) -> jax.Array:
     return chunked_cross_entropy(hidden.reshape(b * s, e), wte, labels.reshape(b * s), chunk=chunk)
 
 
+def lm_loss_fn_pallas(model, batch, block_r: int = 512, block_v: int = 2048) -> jax.Array:
+    """Next-token LM loss through the Pallas fused head+CE kernel
+    (`ops/fused_ce.py`): logits tiles live only in VMEM, row chunks run as
+    parallel grid cells (no scan serialization). Drop-in for `lm_loss_fn`."""
+    from ..ops.fused_ce import fused_cross_entropy
+
+    hidden = model(batch["input_ids"], return_hidden=True)
+    labels = batch.get("labels")
+    if labels is None:
+        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    b, s, e = hidden.shape
+    wte = model.params["wte"].astype(hidden.dtype)
+    return fused_cross_entropy(
+        hidden.reshape(b * s, e), wte, labels.reshape(b * s), block_r=block_r, block_v=block_v
+    )
+
+
 def gpt2_blockwise(config: GPT2Config):
     """Decompose GPT-2 into sequential blocks for offload-streaming inference
     (`big_modeling.BlockwiseModel`): embed -> block_i... -> head. Use with

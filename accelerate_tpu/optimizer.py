@@ -48,6 +48,9 @@ class AcceleratedOptimizer:
         self.step_was_skipped = False
         self._unscaled = False  # grads already unscaled this boundary
         self._num_updates = 0
+        # fused-path fp16 bookkeeping: skipped boundaries accumulate as a lazy
+        # device scalar so the hot loop never syncs; `num_updates` subtracts it
+        self._skipped_updates = jnp.zeros((), jnp.int32)
         if model is not None:
             self._init_state()
 
@@ -137,7 +140,8 @@ class AcceleratedOptimizer:
     # ------------------------------------------------------------- inspection
     @property
     def num_updates(self) -> int:
-        return self._num_updates
+        """APPLIED updates (skipped fp16 boundaries excluded, both paths)."""
+        return self._num_updates - int(self._skipped_updates)
 
     @property
     def learning_rate(self) -> float | None:

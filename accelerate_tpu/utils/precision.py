@@ -118,21 +118,31 @@ class DynamicGradScaler:
     def scale_loss(self, loss: jax.Array, state: GradScalerState) -> jax.Array:
         return loss * state.scale
 
-    def unscale_and_update(self, grads: Any, state: GradScalerState):
-        """Unscale grads; detect non-finite values; return
-        (unscaled_grads, new_state, is_finite)."""
-        inv = 1.0 / state.scale
-        grads = jax.tree.map(lambda g: (g * inv).astype(g.dtype), grads)
-        leaves = jax.tree.leaves(grads)
+    @staticmethod
+    def all_finite(grads: Any) -> jax.Array:
+        """Scalar bool: every leaf of ``grads`` is finite."""
         finite = jnp.asarray(True)
-        for leaf in leaves:
+        for leaf in jax.tree.leaves(grads):
             finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
-        new_tracker = jnp.where(finite, state.growth_tracker + 1, 0)
-        grow = new_tracker >= self.growth_interval
+        return finite
+
+    def update_state(self, state: GradScalerState, finite: jax.Array) -> GradScalerState:
+        """One torch-GradScaler policy step: grow after ``growth_interval``
+        finite boundaries (capped at max_scale), back off on overflow. The ONE
+        implementation shared by the imperative and fused training paths."""
+        tracker = jnp.where(finite, state.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
         new_scale = jnp.where(
             finite,
             jnp.where(grow, jnp.minimum(state.scale * self.growth_factor, self.max_scale), state.scale),
             state.scale * self.backoff_factor,
         )
-        new_tracker = jnp.where(grow, 0, new_tracker)
-        return grads, GradScalerState(scale=new_scale, growth_tracker=new_tracker), finite
+        return GradScalerState(scale=new_scale, growth_tracker=jnp.where(grow, 0, tracker))
+
+    def unscale_and_update(self, grads: Any, state: GradScalerState):
+        """Unscale grads; detect non-finite values; return
+        (unscaled_grads, new_state, is_finite)."""
+        inv = 1.0 / state.scale
+        grads = jax.tree.map(lambda g: (g * inv).astype(g.dtype), grads)
+        finite = self.all_finite(grads)
+        return grads, self.update_state(state, finite), finite

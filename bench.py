@@ -11,7 +11,8 @@ Env knobs (all optional):
   BENCH_ATTN        flash | xla           attention implementation
   BENCH_SCAN=1      lax.scan over layers (faster compile, one compiled block)
   BENCH_REMAT       full | dots | dots_no_batch   remat policy (default off)
-  BENCH_FUSED_CE=1  fused head+chunked cross-entropy (no full-logits tensor)
+  BENCH_FUSED_CE    1: lax.scan chunked head+CE; 2: Pallas fused-CE kernel
+                    (both avoid the full [b,s,V] logits tensor)
   BENCH_CE_CHUNK    fused-CE row-chunk size (default 1024)
   BENCH_PREFETCH=1  feed batches through the native C++ staging ring
   BENCH_TIMEOUT     watchdog seconds (default 540): if the device never
@@ -127,7 +128,13 @@ def main() -> None:
     import optax
 
     from accelerate_tpu.accelerator import Accelerator
-    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn, lm_loss_fn_fused
+    from accelerate_tpu.models.gpt2 import (
+        GPT2Config,
+        GPT2LMHead,
+        lm_loss_fn,
+        lm_loss_fn_fused,
+        lm_loss_fn_pallas,
+    )
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
@@ -153,11 +160,13 @@ def main() -> None:
     state["stage"] = "init_params"
     params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
     model, opt = acc.prepare((module, params), optax.adamw(1e-4))
-    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
-    if fused_ce:
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0")
+    if fused_ce == "1":
         import functools
 
         loss_fn = functools.partial(lm_loss_fn_fused, chunk=_env_int("BENCH_CE_CHUNK", 1024))
+    elif fused_ce == "2":
+        loss_fn = lm_loss_fn_pallas
     else:
         loss_fn = lm_loss_fn
     step = acc.make_train_step(loss_fn)

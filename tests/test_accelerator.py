@@ -366,3 +366,41 @@ class TestAcceleratorBasics:
                 sched.step()
                 opt.zero_grad()
         assert sched.scheduler.count == 2  # 4 batches / accum 2
+
+
+class TestFusedFp16:
+    def test_fused_step_scales_and_recovers(self):
+        """make_train_step under fp16: healthy steps apply updates with the
+        split scale active; an injected overflow skips the update, halves the
+        scale, and the next boundary recovers (reference GradScaler semantics
+        in the fused path)."""
+        acc = _fresh_accelerator(mixed_precision="fp16")
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.05))
+        step = acc.make_train_step(regression_loss_fn)
+        batches = make_regression_batches(4, 16)
+        scale0 = float(opt.scaler_state.scale)
+        losses = []
+        for i, batch in enumerate(batches):
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            before = np.asarray(model.params["a"]).copy()
+            if i == 1:  # poison the batch -> non-finite grads
+                batch = {"x": batch["x"].at[0].set(jnp.inf), "y": batch["y"]}
+            losses.append(float(step(batch)))
+            after = np.asarray(model.params["a"])
+            if i == 1:
+                assert bool(opt.step_was_skipped)
+                np.testing.assert_array_equal(after, before)
+                assert float(opt.scaler_state.scale) == pytest.approx(scale0 / 2)
+            else:
+                assert not bool(opt.step_was_skipped)
+                assert np.any(after != before)
+        assert float(opt.scaler_state.scale) == pytest.approx(scale0 / 2)
+
+    def test_fused_fp16_matches_fp32_training(self):
+        """On a well-conditioned problem the fp16 fused path must land close
+        to the fp32 result (scaling is numerically neutral)."""
+        batches = make_regression_batches(6, 32)
+        acc = _fresh_accelerator(mixed_precision="fp16")
+        got = _train(acc, batches, lr=0.05, use_fused=True)
+        ref = _train_reference(batches, lr=0.05)
+        np.testing.assert_allclose(got["a"], ref["a"], atol=2e-2)

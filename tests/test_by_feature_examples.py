@@ -58,3 +58,17 @@ def test_by_feature_example_runs(tmp_path, script):
     out = subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=600)
     assert out.returncode == 0, f"{script}:\n{out.stdout}\n{out.stderr}"
     assert ("accuracy" in out.stdout) or ("loss" in out.stdout), out.stdout
+
+
+def test_scripts_stay_in_sync_with_common_base():
+    """Source-sync check (reference `tests/test_examples.py` diff-checks each
+    by_feature script against the base example): every script must build on the
+    shared `_common` workload and drive training through the Accelerator API,
+    so feature scripts can't drift into bespoke setups that rot."""
+    for name in SCRIPTS:
+        src = (BY_FEATURE / name).read_text()
+        assert "_common" in src, f"{name} does not use the shared _common base"
+        assert "Accelerator(" in src, f"{name} does not construct an Accelerator"
+        assert (
+            "make_train_step" in src or "backward(" in src or "make_local_train_step" in src
+        ), f"{name} does not train through the framework API"
