@@ -848,6 +848,12 @@ class Accelerator:
         cross-replica gradient reduction. Data-parallel only, like DDP comm hooks.
         With gradient accumulation the hook reduces every microbatch (DDP-without-
         no_sync semantics); the common ``k == 1`` path matches DDP exactly.
+
+        fp16 note: overflow skip/backoff state stays on-device (no per-step
+        sync), but a prepared *scheduler* must read ``step_was_skipped`` each
+        boundary to mirror torch's skip-aware LR stepping — fp16 + scheduler
+        therefore pays one host sync per boundary (torch's GradScaler does
+        too); bf16 never does.
         """
         if model is None:
             model = self._models[0]
@@ -986,6 +992,7 @@ class Accelerator:
         def make_update(lgr):
             def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err, inv_k, scaler_state):
                 inner = _split(scaler_state)
+                comm_rep_in, comm_err_in = comm_rep, comm_err
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err, inner
                 )
@@ -1002,13 +1009,19 @@ class Accelerator:
                 updates, new_opt_state = tx.update(grads, opt_state, params)
                 new_params = constrain_like_params(optax.apply_updates(params, updates))
                 if scaler is not None:
-                    # skip the update on overflow; torch-GradScaler growth/backoff
-                    new_params = jax.tree.map(
-                        lambda new, old: jnp.where(finite, new, old), new_params, params
-                    )
-                    new_opt_state = jax.tree.map(
-                        lambda new, old: jnp.where(finite, new, old), new_opt_state, opt_state
-                    )
+                    # skip the update on overflow; torch-GradScaler growth/backoff.
+                    # Comm-hook state rolls back too — non-finite PowerSGD
+                    # error-feedback residuals would otherwise poison every
+                    # subsequent boundary's gradients permanently.
+                    def _keep_old(new, old):
+                        return jax.tree.map(lambda a, b: jnp.where(finite, a, b), new, old)
+
+                    new_params = _keep_old(new_params, params)
+                    new_opt_state = _keep_old(new_opt_state, opt_state)
+                    if comm_rep_in is not None:
+                        comm_rep = _keep_old(comm_rep, comm_rep_in)
+                    if comm_err_in is not None:
+                        comm_err = _keep_old(comm_err, comm_err_in)
                     scaler_state = scaler.update_state(scaler_state, finite)
                 return new_params, new_opt_state, mstate, loss, comm_rep, comm_err, scaler_state, finite
 

@@ -244,13 +244,19 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array, ignore_index: int =
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1)
 
 
-def lm_loss_fn(model, batch) -> jax.Array:
-    """Next-token LM loss usable directly with Accelerator.backward/make_train_step."""
-    logits = model(batch["input_ids"])
+def _next_token_labels(batch) -> jax.Array:
+    """Labels for causal LM: explicit ``labels`` or input_ids shifted left with
+    the trailing position ignored."""
     labels = batch.get("labels")
     if labels is None:
         labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    return cross_entropy_loss(logits, labels)
+    return labels
+
+
+def lm_loss_fn(model, batch) -> jax.Array:
+    """Next-token LM loss usable directly with Accelerator.backward/make_train_step."""
+    logits = model(batch["input_ids"])
+    return cross_entropy_loss(logits, _next_token_labels(batch))
 
 
 def chunked_cross_entropy(
@@ -297,9 +303,7 @@ def lm_loss_fn_fused(model, batch, chunk: int = 1024) -> jax.Array:
     """Next-token LM loss with the head fused into chunked CE (no full-logits
     materialization). Drop-in for `lm_loss_fn` on GPT2LMHead models."""
     hidden = model(batch["input_ids"], return_hidden=True)
-    labels = batch.get("labels")
-    if labels is None:
-        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    labels = _next_token_labels(batch)
     b, s, e = hidden.shape
     wte = model.params["wte"].astype(hidden.dtype)
     return chunked_cross_entropy(hidden.reshape(b * s, e), wte, labels.reshape(b * s), chunk=chunk)
@@ -312,9 +316,7 @@ def lm_loss_fn_pallas(model, batch, block_r: int = 512, block_v: int = 2048) -> 
     from ..ops.fused_ce import fused_cross_entropy
 
     hidden = model(batch["input_ids"], return_hidden=True)
-    labels = batch.get("labels")
-    if labels is None:
-        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    labels = _next_token_labels(batch)
     b, s, e = hidden.shape
     wte = model.params["wte"].astype(hidden.dtype)
     return fused_cross_entropy(

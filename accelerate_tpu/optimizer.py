@@ -160,7 +160,11 @@ class AcceleratedOptimizer:
 
     # ------------------------------------------------------------ checkpointing
     def state_dict(self) -> dict[str, Any]:
-        out: dict[str, Any] = {"opt_state": self.opt_state, "num_updates": self._num_updates}
+        out: dict[str, Any] = {
+            "opt_state": self.opt_state,
+            "num_updates": self._num_updates,
+            "skipped_updates": int(self._skipped_updates),
+        }
         if self.scaler_state is not None:
             out["scaler_state"] = self.scaler_state
         return out
@@ -168,5 +172,6 @@ class AcceleratedOptimizer:
     def load_state_dict(self, state: dict[str, Any]) -> None:
         self.opt_state = state["opt_state"]
         self._num_updates = int(state.get("num_updates", 0))
+        self._skipped_updates = jnp.asarray(int(state.get("skipped_updates", 0)), jnp.int32)
         if "scaler_state" in state and self.scaler is not None:
             self.scaler_state = GradScalerState(*state["scaler_state"])

@@ -1,0 +1,78 @@
+"""Launched performance/quality assertion on 2 real JAX processes (reference
+`test_utils/scripts/external_deps/test_performance.py` role): the same
+classification workload trained through the full framework flow must reach a
+quality threshold, and per-process peak memory must stay bounded (the
+`test_peak_memory_usage` role — host RSS here; `Device.memory_stats` has no
+meaning on the CPU debug tier)."""
+
+
+def run_checks():
+    import resource
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2, state.num_processes
+
+    # separable 2-class problem, identical on both processes; each feeds its half
+    rng = np.random.RandomState(11)
+    n, feats = 512, 16
+    labels = rng.randint(0, 2, n).astype(np.int32)
+    x = rng.randn(n, feats).astype(np.float32) + labels[:, None] * 1.5
+    half = 16
+    lo = state.process_index * half
+    batches = [
+        {"x": x[i : i + 32][lo : lo + half], "labels": labels[i : i + 32][lo : lo + half]}
+        for i in range(0, n, 32)
+    ]
+
+    acc = Accelerator()
+    params = {
+        "w1": rng.randn(feats, 32).astype(np.float32) * 0.1,
+        "b1": np.zeros(32, np.float32),
+        "w2": rng.randn(32, 2).astype(np.float32) * 0.1,
+        "b2": np.zeros(2, np.float32),
+    }
+
+    def apply_fn(p, xb):
+        h = jnp.tanh(xb @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def loss_fn(m, b):
+        logits = m(b["x"])
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(lp, b["labels"][:, None], axis=-1).mean()
+
+    model, opt, dl = acc.prepare((apply_fn, params), optax.adam(5e-3), DataLoaderShard(batches))
+    step = acc.make_train_step(loss_fn)
+    for _ in range(6):
+        for b in dl:
+            step(b)
+
+    # quality threshold on the full dataset (reference asserts accuracy bounds)
+    logits = model(jnp.asarray(x))
+    acc_val = float((jnp.argmax(logits, -1) == jnp.asarray(labels)).mean())
+    assert acc_val > 0.85, f"accuracy {acc_val} below threshold"
+
+    # peak-memory bound: this tiny workload must not balloon host RSS
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    assert peak_mb < 4096, f"peak RSS {peak_mb:.0f} MiB exceeds bound"
+    state.wait_for_everyone()
+    print(
+        f"proc {state.process_index}: performance OK (acc={acc_val:.3f}, peak={peak_mb:.0f} MiB)",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    from accelerate_tpu.state import PartialState
+
+    PartialState()
+    run_checks()

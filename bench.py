@@ -8,6 +8,7 @@ vs_baseline = achieved_MFU / 0.40 (>1.0 beats the target).
 
 Env knobs (all optional):
   BENCH_ITERS / BENCH_BATCH / BENCH_SEQ   timing-loop shape
+  BENCH_MODEL       small | medium (BASELINE.md north star is gpt2-medium MFU)
   BENCH_ATTN        flash | xla           attention implementation
   BENCH_SCAN=1      lax.scan over layers (faster compile, one compiled block)
   BENCH_REMAT       full | dots | dots_no_batch   remat policy (default off)
@@ -140,9 +141,11 @@ def main() -> None:
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     remat = os.environ.get("BENCH_REMAT", "")
-    # GPT-2 small on one v5e chip; CPU fallback uses a tiny config so CI completes
+    # GPT-2 on one v5e chip; CPU fallback uses a tiny config so CI completes
+    model_name = os.environ.get("BENCH_MODEL", "small")
     if on_tpu:
-        cfg = GPT2Config.small(
+        cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
+        cfg = cfg_cls(
             dtype=jnp.bfloat16, attention_impl=attn, scan_layers=scan,
             remat=bool(remat), remat_policy=remat or None,
         )
@@ -213,7 +216,7 @@ def main() -> None:
         round(mfu / 0.40, 4),
         {
             "mfu": round(mfu, 4),
-            "model": "gpt2-small" if on_tpu else "gpt2-tiny(cpu)",
+            "model": f"gpt2-{model_name}" if on_tpu else "gpt2-tiny(cpu)",
             "batch": batch,
             "seq": seq,
             "attn": attn,

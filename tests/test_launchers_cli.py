@@ -242,3 +242,19 @@ def test_notebook_launcher_rejects_nesting(monkeypatch):
     monkeypatch.setenv("ACCELERATE_TPU_NUM_PROCESSES", "2")
     with pytest.raises(RuntimeError, match="nest"):
         notebook_launcher(lambda: None, num_processes=2)
+
+
+@pytest.mark.slow
+def test_performance_script():
+    """Tier-2: trained-quality + peak-memory assertions on 2 real JAX
+    processes (reference external_deps test_performance/test_peak_memory role)."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_performance
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_performance.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
