@@ -980,9 +980,24 @@ class Accelerator:
             @functools.partial(jax.jit, donate_argnums=(1, 2, 5) if donate else ())
             def micro_step(params, mstate, acc, batch, comm_rep, comm_err, scaler_state):
                 inner = _split(scaler_state)
+                comm_rep_in, comm_err_in = comm_rep, comm_err
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err, inner
                 )
+                if scaler is not None:
+                    # guard comm-hook state PER MICROBATCH: an overflowing
+                    # microbatch must not fold non-finite residuals into the
+                    # error-feedback buffers (the boundary rollback can only
+                    # restore to the state entering ITS call)
+                    fin = scaler.all_finite(grads)
+                    if comm_rep_in is not None:
+                        comm_rep = jax.tree.map(
+                            lambda a, b: jnp.where(fin, a, b), comm_rep, comm_rep_in
+                        )
+                    if comm_err_in is not None:
+                        comm_err = jax.tree.map(
+                            lambda a, b: jnp.where(fin, a, b), comm_err, comm_err_in
+                        )
                 grads = constrain_like_params(grads)
                 acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
                 return acc, mstate, loss, comm_rep, comm_err

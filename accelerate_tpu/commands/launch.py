@@ -77,16 +77,31 @@ def _child_command(script: str, script_args: list[str], module: bool) -> list[st
 
 
 def _debug_cpu_launch(
-    n: int, script: str, script_args: list[str], base_env: dict[str, str], module: bool = False
+    n: int,
+    script: str,
+    script_args: list[str],
+    base_env: dict[str, str],
+    module: bool = False,
+    max_restarts: int = 0,
+    monitor_interval: float = 0.5,
 ) -> int:
-    """Fork n local JAX processes over a localhost coordinator (CPU platform)."""
+    """Fork n local JAX 'hosts' over a localhost coordinator (CPU platform).
+
+    With ``max_restarts`` this is the cross-host elastic tier (the torchelastic
+    rendezvous role, reference `commands/launch.py:793`): when one host dies,
+    its peers crash out of their collectives, every host's supervisor restarts
+    its child, and the new generation re-forms at the SAME coordinator address
+    — jax.distributed's barrier is the rendezvous. Each generation reads
+    ``ACCELERATE_TPU_RESTART_COUNT`` and resumes from the latest checkpoint.
+    """
     import socket
+    import time
 
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    procs = []
-    for i in range(n):
+
+    def _spawn(i: int, restarts: int) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(base_env)
         env.update(
@@ -97,13 +112,48 @@ def _debug_cpu_launch(
                 "JAX_NUM_PROCESSES": str(n),
                 "JAX_PROCESS_ID": str(i),
                 "ACCELERATE_TPU_NUM_PROCESSES": str(n),
+                "ACCELERATE_TPU_RESTART_COUNT": str(restarts),
             }
         )
-        procs.append(subprocess.Popen(_child_command(script, script_args, module), env=env))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+        return subprocess.Popen(_child_command(script, script_args, module), env=env)
+
+    restarts = 0
+    procs = [_spawn(i, restarts) for i in range(n)]
+    if max_restarts <= 0:
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    while True:
+        rcs = [p.poll() for p in procs]
+        if all(rc == 0 for rc in rcs):
+            return 0
+        if any(rc is not None and rc != 0 for rc in rcs):
+            if restarts >= max_restarts:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                return next(rc for rc in rcs if rc)
+            # one host failed: tear down the generation, restart ALL hosts so
+            # the new generation rendezvouses together (elastic semantics)
+            restarts += 1
+            print(
+                f"[accelerate-tpu launch] generation failed (exit codes {rcs}); "
+                f"restart {restarts}/{max_restarts}.",
+                file=sys.stderr,
+            )
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    # torchelastic-style escalation: SIGTERM grace, then SIGKILL
+                    p.kill()
+                    p.wait()
+            procs = [_spawn(i, restarts) for i in range(n)]
+        time.sleep(monitor_interval)
 
 
 def _supervised_launch(
@@ -179,6 +229,8 @@ def launch_command(args: argparse.Namespace) -> None:
         rc = _debug_cpu_launch(
             args.debug_cpu, args.training_script, args.training_script_args, env,
             module=args.module,
+            max_restarts=args.max_restarts,
+            monitor_interval=args.monitor_interval,
         )
         sys.exit(rc)
     if args.max_restarts:

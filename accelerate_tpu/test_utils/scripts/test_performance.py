@@ -62,7 +62,11 @@ def run_checks():
     assert acc_val > 0.85, f"accuracy {acc_val} below threshold"
 
     # peak-memory bound: this tiny workload must not balloon host RSS
-    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    # (ru_maxrss: kilobytes on Linux, bytes on macOS)
+    import sys
+
+    divisor = 1024 * 1024 if sys.platform == "darwin" else 1024
+    peak_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / divisor
     assert peak_mb < 4096, f"peak RSS {peak_mb:.0f} MiB exceeds bound"
     state.wait_for_everyone()
     print(

@@ -99,3 +99,39 @@ def test_deepspeed_auto_values_keep_defaults(tmp_path):
     assert plugin.gradient_accumulation_steps == 1
     assert plugin.gradient_clipping is None
     assert plugin.mixed_precision is None
+
+
+MULTIHOST_CRASHY = """
+import os, sys
+from pathlib import Path
+attempt = int(os.environ.get("ACCELERATE_TPU_RESTART_COUNT", "0"))
+pid = int(os.environ["JAX_PROCESS_ID"])
+from accelerate_tpu.state import PartialState
+state = PartialState()  # jax.distributed rendezvous at the shared coordinator
+assert state.num_processes == 2
+if attempt == 0 and pid == 1:
+    sys.exit(23)  # host 1 dies in generation 0
+# generation 1: both hosts must have re-rendezvoused; prove a collective works
+from accelerate_tpu.utils import operations
+got = operations.gather_object([f"p{state.process_index}a{attempt}"])
+assert got == ["p0a1", "p1a1"], got
+Path(sys.argv[1] + f".{pid}").write_text(str(attempt))
+print(f"host {pid} recovered on generation {attempt}")
+"""
+
+
+def test_multihost_generation_restart(tmp_path):
+    """Cross-host elastic tier (torchelastic rendezvous role): one host dying
+    tears down the generation; ALL hosts restart and re-form at the same
+    coordinator, and collectives work in the new generation."""
+    marker = tmp_path / "gen"
+    out = _launch(
+        tmp_path,
+        ["--debug_cpu", "2", "--max_restarts", "2", "--monitor_interval", "0.1"],
+        MULTIHOST_CRASHY,
+        script_args=[marker],
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (tmp_path / "gen.0").read_text() == "1"
+    assert (tmp_path / "gen.1").read_text() == "1"
+    assert "restart 1/2" in out.stderr
