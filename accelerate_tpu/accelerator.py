@@ -977,27 +977,16 @@ class Accelerator:
             # acc / mstate / comm_err are consumed and replaced every call:
             # donating them keeps ONE gradient accumulator in HBM instead of
             # old+new copies during each microbatch.
+            # NOTE: persistent comm-hook state is overflow-guarded per leaf
+            # INSIDE reduce_gradients (compression._powersgd_leaf), so
+            # non-finite microbatches can't poison it on ANY path and the
+            # donated error buffers keep per-leaf lifetimes.
             @functools.partial(jax.jit, donate_argnums=(1, 2, 5) if donate else ())
             def micro_step(params, mstate, acc, batch, comm_rep, comm_err, scaler_state):
                 inner = _split(scaler_state)
-                comm_rep_in, comm_err_in = comm_rep, comm_err
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err, inner
                 )
-                if scaler is not None:
-                    # guard comm-hook state PER MICROBATCH: an overflowing
-                    # microbatch must not fold non-finite residuals into the
-                    # error-feedback buffers (the boundary rollback can only
-                    # restore to the state entering ITS call)
-                    fin = scaler.all_finite(grads)
-                    if comm_rep_in is not None:
-                        comm_rep = jax.tree.map(
-                            lambda a, b: jnp.where(fin, a, b), comm_rep, comm_rep_in
-                        )
-                    if comm_err_in is not None:
-                        comm_err = jax.tree.map(
-                            lambda a, b: jnp.where(fin, a, b), comm_err, comm_err_in
-                        )
                 grads = constrain_like_params(grads)
                 acc = grads if acc is None else jax.tree.map(jnp.add, acc, grads)
                 return acc, mstate, loss, comm_rep, comm_err
@@ -1007,7 +996,6 @@ class Accelerator:
         def make_update(lgr):
             def _update(params, opt_state, mstate, acc, batch, comm_rep, comm_err, inv_k, scaler_state):
                 inner = _split(scaler_state)
-                comm_rep_in, comm_err_in = comm_rep, comm_err
                 loss, grads, mstate, comm_rep, comm_err = lgr(
                     params, mstate, batch, comm_rep, comm_err, inner
                 )
@@ -1024,19 +1012,14 @@ class Accelerator:
                 updates, new_opt_state = tx.update(grads, opt_state, params)
                 new_params = constrain_like_params(optax.apply_updates(params, updates))
                 if scaler is not None:
-                    # skip the update on overflow; torch-GradScaler growth/backoff.
-                    # Comm-hook state rolls back too — non-finite PowerSGD
-                    # error-feedback residuals would otherwise poison every
-                    # subsequent boundary's gradients permanently.
-                    def _keep_old(new, old):
-                        return jax.tree.map(lambda a, b: jnp.where(finite, a, b), new, old)
-
-                    new_params = _keep_old(new_params, params)
-                    new_opt_state = _keep_old(new_opt_state, opt_state)
-                    if comm_rep_in is not None:
-                        comm_rep = _keep_old(comm_rep, comm_rep_in)
-                    if comm_err_in is not None:
-                        comm_err = _keep_old(comm_err, comm_err_in)
+                    # skip the update on overflow; torch-GradScaler growth/backoff
+                    # (persistent comm-hook state is guarded inside the hook)
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_params, params
+                    )
+                    new_opt_state = jax.tree.map(
+                        lambda new, old: jnp.where(finite, new, old), new_opt_state, opt_state
+                    )
                     scaler_state = scaler.update_state(scaler_state, finite)
                 return new_params, new_opt_state, mstate, loss, comm_rep, comm_err, scaler_state, finite
 

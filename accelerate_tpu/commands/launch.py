@@ -133,6 +133,12 @@ def _debug_cpu_launch(
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()
+                for p in procs:  # same SIGTERM->SIGKILL escalation as restarts
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
                 return next(rc for rc in rcs if rc)
             # one host failed: tear down the generation, restart ALL hosts so
             # the new generation rendezvouses together (elastic semantics)

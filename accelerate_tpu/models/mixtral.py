@@ -124,11 +124,16 @@ class MixtralSparseMoeBlock(nn.Module):
         out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), expert_out)
 
         # HF load-balancing aux loss: fraction of tokens per expert counted over
-        # ALL top-k selections x mean full-softmax prob per expert
+        # ALL top-k selections (summed over slots, NOT divided by k — HF's
+        # load_balancing_loss_func sums the top-k one-hots) x mean full-softmax
+        # prob. HF computes ONE loss over the concat of every layer's gates
+        # (i.e. a mean across layers); the sown per-layer terms are summed by
+        # collect_aux_losses, so divide by num_layers here to land on the same
+        # total magnitude for the default router_aux_loss_coef.
         all_sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, k, E]
-        me = jnp.mean(jnp.sum(all_sel, axis=1) / k, axis=0)  # [E]
+        me = jnp.mean(jnp.sum(all_sel, axis=1), axis=0)  # [E]
         ce = jnp.mean(jax.nn.softmax(router_logits, axis=-1), axis=0)
-        aux = cfg.aux_loss_weight * E * jnp.sum(me * ce)
+        aux = cfg.aux_loss_weight * E * jnp.sum(me * ce) / cfg.num_layers
         sow_aux_loss(self, aux)
         return out.reshape(b, s, e).astype(x.dtype)
 

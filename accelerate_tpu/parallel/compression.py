@@ -172,8 +172,14 @@ def _powersgd_leaf(g: jax.Array, rep: dict | None, err: jax.Array | None, axis: 
     q = m.T @ p  # (N, r)
     q = lax.pmean(q, axis)
     approx = (p @ q.T).reshape(g.shape)
-    new_err = (g32 - approx)[None]  # worker-local residual, fed back next round
-    new_rep = {"q": q, "step": rep["step"] + 1}
+    candidate = g32 - approx  # worker-local residual, fed back next round
+    # A non-finite gradient (fp16 overflow) must not poison the PERSISTENT
+    # hook state: keep the previous residual and warm-start factor for this
+    # leaf. Per-leaf select on the leaf's own finiteness keeps buffer
+    # lifetimes local, so XLA can still alias the donated error buffers.
+    leaf_ok = jnp.all(jnp.isfinite(candidate))
+    new_err = jnp.where(leaf_ok, candidate, err[0])[None]
+    new_rep = {"q": jnp.where(leaf_ok, q, rep["q"]), "step": rep["step"] + 1}
     return approx.astype(g.dtype), new_rep, new_err
 
 
