@@ -31,6 +31,7 @@ def test_suite_is_complete():
         "schedule_free.py",
         "fsdp_with_peak_mem_tracking.py",
         "tensor_parallel_gpt_pretraining.py",  # megatron_lm_gpt_pretraining analogue
+        "deepspeed_with_config_support.py",
     }
     assert expected.issubset(set(SCRIPTS)), expected - set(SCRIPTS)
 
