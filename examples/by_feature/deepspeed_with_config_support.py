@@ -41,6 +41,11 @@ def main() -> None:
             "zero_optimization": {"stage": 3},
         }))
 
+    # ds_config owns precision (the point of this example): reject a
+    # conflicting CLI flag rather than silently discarding it, as the
+    # reference does for ds_config/Accelerator precision conflicts
+    if args.mixed_precision not in (None, "no"):
+        parser.error("--mixed_precision conflicts with the ds_config; set it in the JSON.")
     accelerator = Accelerator(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=ds_config))
     accelerator.print(
         f"ds_config resolved: precision={accelerator.mixed_precision} "

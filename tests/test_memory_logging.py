@@ -1,0 +1,118 @@
+"""OOM-retry utilities and multi-process logging (reference
+`tests/test_memory_utils.py` + `tests/test_logging.py` roles)."""
+
+import logging
+
+import pytest
+
+from accelerate_tpu.logging import get_logger
+from accelerate_tpu.memory import (
+    find_executable_batch_size,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+class TestFindExecutableBatchSize:
+    def test_halves_until_fit(self):
+        seen = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def train(batch_size):
+            seen.append(batch_size)
+            if batch_size > 16:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+            return batch_size
+
+        assert train() == 16
+        assert seen == [128, 64, 32, 16]
+
+    def test_extra_args_forwarded(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def train(batch_size, a, b=2):
+            return batch_size + a + b
+
+        assert train(1, b=3) == 12
+
+    def test_non_oom_errors_propagate(self):
+        @find_executable_batch_size(starting_batch_size=8)
+        def train(batch_size):
+            raise ValueError("unrelated")
+
+        with pytest.raises(ValueError, match="unrelated"):
+            train()
+
+    def test_gives_up_at_zero(self):
+        @find_executable_batch_size(starting_batch_size=2)
+        def train(batch_size):
+            raise RuntimeError("OOM")
+
+        with pytest.raises(RuntimeError):
+            train()
+
+    def test_missing_batch_size_arg_rejected(self):
+        with pytest.raises(TypeError):  # raised at decoration time
+
+            @find_executable_batch_size(starting_batch_size=4)
+            def bad():
+                return 0
+
+    def test_should_reduce_markers(self):
+        assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+        assert should_reduce_batch_size(MemoryError("Out of memory"))
+        assert not should_reduce_batch_size(ValueError("shape mismatch"))
+
+
+def test_release_memory_clears_references():
+    a, b = object(), object()
+    a2, b2 = release_memory(a, b)
+    assert a2 is None and b2 is None
+    assert release_memory(object()) is None
+
+
+class TestMultiProcessLogger:
+    def _capture(self, logger):
+        records = []
+
+        class Sink(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        logger.logger.addHandler(Sink())
+        logger.logger.setLevel(logging.INFO)
+        return records
+
+    def test_main_process_logs_by_default(self):
+        logger = get_logger("t.main")
+        records = self._capture(logger)
+        logger.info("hello")
+        assert records == ["hello"]  # single process == main process
+
+    def test_level_from_env(self, monkeypatch):
+        root_before = logging.getLogger().level
+        monkeypatch.setenv("ACCELERATE_TPU_LOG_LEVEL", "ERROR")
+        try:
+            logger = get_logger("t.env")
+            # the env var itself must have set the level — no manual setLevel
+            assert logger.logger.level == logging.ERROR
+            records = []
+
+            class Sink(logging.Handler):
+                def emit(self, record):
+                    records.append(record.getMessage())
+
+            logger.logger.addHandler(Sink())
+            logger.info("dropped")
+            logger.error("kept")
+            assert records == ["kept"]
+        finally:
+            # get_logger also raises the ROOT level: undo so later tests keep
+            # their propagation behavior
+            logging.getLogger().setLevel(root_before)
+            logging.getLogger("t.env").setLevel(logging.NOTSET)
+
+    def test_in_order_stamps_rank(self):
+        logger = get_logger("t.order")
+        records = self._capture(logger)
+        logger.info("msg", in_order=True)
+        assert records == ["[rank 0] msg"]
