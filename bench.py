@@ -73,21 +73,34 @@ def _emit_probe_failure(why: str) -> None:
     _emit(0.0, 0.0, {"error": kind, "probe": why}, error=kind)
 
 
-def _emit(value: float, vs_baseline: float, detail: dict, **extra) -> None:
-    """The ONE JSON line the driver records; every exit path goes through here."""
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_train_tokens_per_sec_per_chip",
-                "value": value,
-                "unit": "tokens/s/chip",
-                "vs_baseline": vs_baseline,
-                **extra,
-                "detail": detail,
-            }
-        ),
-        flush=True,
-    )
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit(value: float, vs_baseline: float, detail: dict, **extra) -> bool:
+    """The ONE JSON line the driver records; every exit path goes through here.
+    First caller wins — the latch makes the watchdog thread, the SIGTERM
+    handler, and the normal completion path race-safe (exactly one line,
+    never interleaved). Returns whether THIS call emitted."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_train_tokens_per_sec_per_chip",
+                    "value": value,
+                    "unit": "tokens/s/chip",
+                    "vs_baseline": vs_baseline,
+                    **extra,
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
+        return True
 
 
 
@@ -96,25 +109,50 @@ def _arm_watchdog(seconds: int, state: dict) -> None:
     def fire():
         if state.get("done"):
             return
-        _emit(0.0, 0.0, {"error": f"watchdog: device unresponsive after {seconds}s",
-                         "stage": state.get("stage", "startup")},
-              error="device-watchdog")
-        os._exit(2)
+        if _emit(0.0, 0.0, {"error": f"watchdog: device unresponsive after {seconds}s",
+                            "stage": state.get("stage", "startup")},
+                 error="device-watchdog"):
+            os._exit(2)
+        # another path emitted first (completion/SIGTERM): let it finish
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
 
 
+def _install_sigterm_json(state: dict) -> None:
+    """Best effort: an external `timeout` SIGTERM still emits the one JSON line
+    and exits cleanly instead of dying mid-device-operation (a mid-op kill can
+    wedge the relay for every later process — see docs/PERF_NOTES.md)."""
+    import signal
+
+    def on_term(signum, frame):
+        emitted_error = _emit(
+            0.0, 0.0, {"error": f"terminated at stage {state.get('stage')}"},
+            error="terminated",
+        )
+        # if the result line already went out, this is a clean exit
+        os._exit(1 if emitted_error else 0)
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / restricted env
+
+
 def main() -> None:
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
+    state = {"done": False, "stage": "probe"}
+    # handler FIRST: the up-to-180s probe against a dead relay is the longest
+    # hang window and must also die with a JSON line under external timeouts
+    _install_sigterm_json(state)
     if not force_cpu:
         platform, why = _probe_devices(_env_int("BENCH_PROBE_TIMEOUT", 180))
         if platform is None:
             _emit_probe_failure(why)
             sys.exit(0)
 
-    state = {"done": False, "stage": "startup"}
+    state["stage"] = "startup"
     _arm_watchdog(_env_int("BENCH_TIMEOUT", 540), state)
 
     import jax

@@ -41,12 +41,15 @@ def main() -> None:
             "zero_optimization": {"stage": 3},
         }))
 
-    # ds_config owns precision (the point of this example): reject a
-    # conflicting CLI flag rather than silently discarding it, as the
-    # reference does for ds_config/Accelerator precision conflicts
-    if args.mixed_precision not in (None, "no"):
-        parser.error("--mixed_precision conflicts with the ds_config; set it in the JSON.")
     accelerator = Accelerator(deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=ds_config))
+    # ds_config owns precision (the point of this example): reject a CLI flag
+    # that DISAGREES with it rather than silently discarding it, as the
+    # reference does for ds_config/Accelerator precision conflicts
+    if args.mixed_precision != "no" and args.mixed_precision != accelerator.mixed_precision:
+        parser.error(
+            f"--mixed_precision={args.mixed_precision} conflicts with the ds_config's "
+            f"{accelerator.mixed_precision!r}; set precision in the JSON."
+        )
     accelerator.print(
         f"ds_config resolved: precision={accelerator.mixed_precision} "
         f"accum={accelerator.gradient_state.num_steps} "

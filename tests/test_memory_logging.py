@@ -71,7 +71,7 @@ def test_release_memory_clears_references():
 
 
 class TestMultiProcessLogger:
-    def _capture(self, logger):
+    def _capture(self, logger, level=logging.INFO):
         records = []
 
         class Sink(logging.Handler):
@@ -79,7 +79,8 @@ class TestMultiProcessLogger:
                 records.append(record.getMessage())
 
         logger.logger.addHandler(Sink())
-        logger.logger.setLevel(logging.INFO)
+        if level is not None:
+            logger.logger.setLevel(level)
         return records
 
     def test_main_process_logs_by_default(self):
@@ -95,13 +96,7 @@ class TestMultiProcessLogger:
             logger = get_logger("t.env")
             # the env var itself must have set the level — no manual setLevel
             assert logger.logger.level == logging.ERROR
-            records = []
-
-            class Sink(logging.Handler):
-                def emit(self, record):
-                    records.append(record.getMessage())
-
-            logger.logger.addHandler(Sink())
+            records = self._capture(logger, level=None)
             logger.info("dropped")
             logger.error("kept")
             assert records == ["kept"]
