@@ -5,10 +5,13 @@ Capability parity: reference `launchers.py` (302 LoC) — `notebook_launcher`
 CPU run for tests).
 
 TPU-native: inside a notebook on a TPU VM the devices are already attached to
-this process, so `notebook_launcher` just runs the function (per-core forking —
-xmp.spawn — is a torch_xla artifact with no JAX equivalent or need). Multi-*host*
-notebook launching is delegated to the CLI pod fan-out. `debug_launcher` forks
-real OS processes, each a JAX "host" on the CPU platform with a localhost
+this process, so single-host `notebook_launcher` just runs the function
+(per-core forking — xmp.spawn — is a torch_xla artifact with no JAX equivalent
+or need). ``num_processes`` > 1 forks real worker processes that *inherit the
+notebook's interpreter state* — closures and cell-defined functions launch
+without being importable, the property that distinguishes the notebook path
+from `debug_launcher`'s importable-script contract. `debug_launcher` spawns
+fresh OS processes, each a JAX "host" on the CPU platform with a localhost
 coordinator — exercising the true multi-process collective path.
 """
 
@@ -20,7 +23,31 @@ import subprocess
 import sys
 import tempfile
 import textwrap
+import time
+import traceback
 from typing import Callable
+
+
+def _jax_backends_initialized() -> bool:
+    """True once this process has materialized any XLA backend. Forking after
+    that point hands children dead device handles (the reference's analogous
+    guard errors when CUDA is initialized — `launchers.py:are_libraries_initialized`
+    role), so the launcher refuses rather than deadlocking."""
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(xb, "_backends", None))
+
+
+def _notebook_worker(function, args, env: dict) -> None:
+    """Forked child body: point the JAX env contract at the coordinator BEFORE
+    any backend init, run, and `os._exit` so IPython atexit hooks inherited
+    from the notebook kernel never fire in the worker."""
+    os.environ.update(env)
+    try:
+        function(*args)
+    except BaseException:
+        traceback.print_exc()
+        os._exit(1)
+    os._exit(0)
 
 
 def notebook_launcher(
@@ -29,7 +56,11 @@ def notebook_launcher(
     num_processes: int | None = None,
     mixed_precision: str = "no",
     use_port: str = "29500",
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
     max_restarts: int = 0,
+    monitor_interval: float = 0.1,
     **kwargs,
 ) -> None:
     """Start training from a notebook (reference `launchers.py:40-266`).
@@ -37,29 +68,91 @@ def notebook_launcher(
     On a TPU VM every local chip is already attached to THIS process, so the
     single-host case needs no elastic worker spawn: the function runs inline
     over all devices (the reference's per-core xmp.spawn is a torch_xla
-    artifact). Passing ``num_processes`` > 1 forks that many real JAX
-    processes over a localhost coordinator — the reference's multi-worker
-    notebook path, realized with the same process machinery as
-    `debug_launcher` but on the default platform; ``max_restarts`` re-runs a
-    crashed generation, mirroring the reference's elastic agent restarts.
+    artifact). Passing ``num_processes`` > 1 forks that many real JAX worker
+    processes over a coordinator at ``master_addr:use_port`` — because they are
+    *forked*, the function may be a closure defined in a notebook cell, the
+    reference's signature notebook capability. ``num_nodes``/``node_rank``
+    extend the rendezvous across machines running the same notebook code
+    (process ids are offset by ``node_rank * num_processes``). A crashed
+    generation is re-launched up to ``max_restarts`` times, mirroring the
+    reference's elastic-agent restarts; the parent polls children every
+    ``monitor_interval`` seconds and tears the generation down as soon as any
+    worker fails. ``use_port="0"`` picks a free port (single-node only).
     """
     os.environ.setdefault("ACCELERATE_TPU_MIXED_PRECISION", mixed_precision)
-    if num_processes is None or num_processes <= 1:
+    if (num_processes is None or num_processes <= 1) and num_nodes <= 1:
         function(*args)
         return
+    num_processes = num_processes or 1
     if os.environ.get("ACCELERATE_TPU_NUM_PROCESSES"):
         raise RuntimeError(
             "notebook_launcher cannot nest inside an already-launched distributed job."
         )
-    attempt = 0
-    while True:
+    if _jax_backends_initialized():
+        raise RuntimeError(
+            "JAX devices are already initialized in this process; forked workers "
+            "would inherit dead device handles. Restart the notebook kernel and "
+            "call notebook_launcher before running any JAX computation."
+        )
+    import multiprocessing
+
+    try:
+        ctx = multiprocessing.get_context("fork")
+    except ValueError:
+        # no fork on this OS: fall back to the importable-function spawn path
+        debug_launcher(function, args=args, num_processes=num_processes, platform=None)
+        return
+
+    world = num_nodes * num_processes
+    for attempt in range(max_restarts + 1):
+        port = use_port
+        if str(use_port) == "0":
+            import socket
+
+            with socket.socket() as s:
+                s.bind((master_addr, 0))
+                port = str(s.getsockname()[1])
+        procs = []
+        for i in range(num_processes):
+            env = {
+                "JAX_COORDINATOR_ADDRESS": f"{master_addr}:{port}",
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(node_rank * num_processes + i),
+                "ACCELERATE_TPU_NUM_PROCESSES": str(world),
+                "ACCELERATE_TPU_MIXED_PRECISION": mixed_precision,
+            }
+            p = ctx.Process(target=_notebook_worker, args=(function, args, env))
+            p.start()
+            procs.append(p)
         try:
-            debug_launcher(function, args=args, num_processes=num_processes, platform=None)
+            failed = None
+            while failed is None and any(p.is_alive() for p in procs):
+                time.sleep(monitor_interval)
+                failed = next(
+                    (p for p in procs if p.exitcode not in (None, 0)), None
+                )
+            if failed is None:
+                failed = next((p for p in procs if p.exitcode not in (None, 0)), None)
+        except (KeyboardInterrupt, SystemExit):
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join()
+            raise
+        if failed is not None:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+        for p in procs:
+            p.join()
+        if failed is None:
             return
-        except RuntimeError:
-            if attempt >= max_restarts:
-                raise
-            attempt += 1
+        if attempt == max_restarts:
+            raise RuntimeError(
+                f"notebook_launcher worker {procs.index(failed)} failed with exit code "
+                f"{failed.exitcode} (after {attempt} restart(s))"
+            )
 
 
 def debug_launcher(

@@ -4,6 +4,7 @@
 import os
 import subprocess
 import sys
+import textwrap
 from pathlib import Path
 
 import pytest
@@ -216,24 +217,94 @@ def test_dispatcher_script_multidevice():
         os.environ.update(env_backup)
 
 
-@pytest.mark.slow
-def test_notebook_launcher_multiprocess():
-    """notebook_launcher(num_processes=2) forks real JAX workers (reference
-    launchers.py:40-266 multi-worker notebook path)."""
-    from accelerate_tpu.launchers import notebook_launcher
-    from accelerate_tpu.test_utils.scripts import test_multiprocess_ops
+def _run_notebook_sim(body: str, tmp_path, timeout: int = 300) -> subprocess.CompletedProcess:
+    """Run ``body`` in a fresh interpreter simulating a notebook kernel: no JAX
+    touched yet, function defined at 'cell' scope (inside main(), NOT importable),
+    CPU platform pinned for the test host."""
+    script = tmp_path / "nb.py"
+    script.write_text(
+        "from accelerate_tpu.launchers import notebook_launcher\n"
+        "def main():\n"
+        + textwrap.indent(body, "    ")
+        + "\nmain()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("ACCELERATE_TPU_NUM_PROCESSES", None)
+    # Platform pinning must happen in the ENV, before interpreter startup:
+    # environments whose sitecustomize imports jax pin the platform config
+    # at startup, so in-script os.environ writes are too late.
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return subprocess.run(
+        [sys.executable, str(script)], env=env, timeout=timeout,
+        capture_output=True, text=True,
+    )
 
-    env_backup = dict(os.environ)
-    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
-    # workers inherit the parent platform (that's the point of the notebook
-    # path); pin it to cpu for the test host
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    try:
-        notebook_launcher(test_multiprocess_ops.run_checks, num_processes=2)
-    finally:
-        os.environ.clear()
-        os.environ.update(env_backup)
+
+@pytest.mark.slow
+def test_notebook_launcher_closure_multiprocess(tmp_path):
+    """notebook_launcher forks real JAX workers from a *closure* — a function
+    defined in a notebook cell, unreachable by import (reference
+    launchers.py:40-266: the fork start method is what makes cell-defined
+    training functions launchable)."""
+    proof = tmp_path / "proof"
+    body = f"""
+        captured = "closure-state"  # NOT visible to an importing child
+        def train():
+            import jax
+            from accelerate_tpu.state import PartialState
+            state = PartialState()
+            assert state.num_processes == 2, state.num_processes
+            assert captured == "closure-state"
+            from jax.experimental.multihost_utils import process_allgather
+            got = process_allgather(jax.numpy.asarray([state.process_index]))
+            assert sorted(got.ravel().tolist()) == [0, 1], got
+            if state.is_main_process:
+                open({str(proof)!r}, "w").write("ok")
+        notebook_launcher(train, num_processes=2, use_port="0")
+    """
+    res = _run_notebook_sim(textwrap.dedent(body), tmp_path)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert proof.read_text() == "ok"
+
+
+@pytest.mark.slow
+def test_notebook_launcher_restarts_failed_generation(tmp_path):
+    """A crashed worker generation is torn down and relaunched up to
+    max_restarts (reference elastic-agent restart semantics)."""
+    marker = tmp_path / "gen1"
+    body = f"""
+        def train():
+            import os
+            from accelerate_tpu.state import PartialState
+            state = PartialState()
+            if not os.path.exists({str(marker)!r}):
+                if state.is_main_process:
+                    open({str(marker)!r}, "w").write("x")
+                raise RuntimeError("induced first-generation failure")
+        notebook_launcher(train, num_processes=2, use_port="0", max_restarts=1)
+    """
+    res = _run_notebook_sim(textwrap.dedent(body), tmp_path)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert marker.exists()
+
+
+def test_notebook_launcher_guards_initialized_jax(tmp_path):
+    """Forking after XLA backends exist hands workers dead device handles;
+    the launcher must refuse with an actionable error instead."""
+    body = """
+        import jax
+        jax.numpy.zeros(1).block_until_ready()  # materialize a backend
+        try:
+            notebook_launcher(lambda: None, num_processes=2, use_port="0")
+        except RuntimeError as e:
+            assert "Restart the notebook kernel" in str(e), e
+        else:
+            raise AssertionError("guard did not fire")
+    """
+    res = _run_notebook_sim(textwrap.dedent(body), tmp_path)
+    assert res.returncode == 0, res.stderr[-2000:]
 
 
 def test_notebook_launcher_rejects_nesting(monkeypatch):
