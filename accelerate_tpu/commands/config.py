@@ -107,13 +107,12 @@ def write_basic_config(mixed_precision: str = "no", save_location: str | None = 
 
 
 def _ask(prompt: str, default: str, choices: list[str] | None = None) -> str:
-    suffix = f" [{'/'.join(choices)}]" if choices else ""
-    raw = input(f"{prompt}{suffix} ({default}): ").strip()
-    value = raw or default
-    if choices and value not in choices:
-        print(f"  invalid choice {value!r}, using {default}")
-        return default
-    return value
+    if choices:
+        from .menu import choose
+
+        return choose(prompt, choices, default)
+    raw = input(f"{prompt} ({default}): ").strip()
+    return raw or default
 
 
 def config_command(args: argparse.Namespace) -> None:

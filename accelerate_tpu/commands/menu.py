@@ -1,0 +1,157 @@
+"""Arrow-key selection menu for the interactive ``config`` questionnaire.
+
+Capability parity: reference `commands/menu/` (~450 LoC: cursor helpers, keymap,
+selection widget used by `commands/config/cluster.py`). Re-founded compactly:
+one class, raw-terminal key decoding inline, and an injectable key reader so
+tests can script keystrokes without a pty. Falls back to a numbered prompt when
+stdin isn't a TTY (CI, piped input) — the reference menu simply crashes there,
+so the fallback is a deliberate hardening, not a parity break.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Sequence
+
+# decoded key events produced by _read_key
+UP, DOWN, ENTER, INTERRUPT = "up", "down", "enter", "interrupt"
+
+
+def _read_key(stream=None) -> str:
+    """Block for one keypress on the controlling terminal and decode it to a
+    key event or a literal character. Raw mode spans exactly one key so ^C
+    remains deliverable between keys."""
+    import termios
+    import tty
+
+    import select
+
+    stream = stream or sys.stdin
+    fd = stream.fileno()
+    saved = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        ch = stream.read(1)
+        if ch == "\x1b":  # escape sequence: arrows are ESC [ A/B
+            # a bare Esc press has no tail — poll so it doesn't block the menu
+            # (and later keystrokes aren't eaten as a phantom escape tail)
+            tail = ""
+            while len(tail) < 2 and select.select([fd], [], [], 0.05)[0]:
+                tail += stream.read(1)
+            if tail in ("[A", "OA"):
+                return UP
+            if tail in ("[B", "OB"):
+                return DOWN
+            return ""
+        if ch in ("\r", "\n"):
+            return ENTER
+        if ch == "\x03":
+            return INTERRUPT
+        return ch
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, saved)
+
+
+class SelectionMenu:
+    """Interactive single-choice menu.
+
+    Keys: ↑/↓ (also k/j) move, digits jump, Enter selects, ^C raises
+    KeyboardInterrupt. ``run()`` returns the selected *index*.
+    """
+
+    def __init__(
+        self,
+        prompt: str,
+        choices: Sequence[str],
+        default_index: int = 0,
+        key_reader: Callable[[], str] | None = None,
+        out=None,
+    ):
+        if not choices:
+            raise ValueError("SelectionMenu needs at least one choice")
+        self.prompt = prompt
+        self.choices = list(choices)
+        self.index = min(max(default_index, 0), len(choices) - 1)
+        self._read = key_reader or _read_key
+        self._out = out or sys.stdout
+
+    # one menu line, highlighted when selected
+    def _line(self, i: int) -> str:
+        marker = "●" if i == self.index else " "
+        text = f" {marker} {i}. {self.choices[i]}"
+        return f"\x1b[7m{text}\x1b[0m" if i == self.index else text
+
+    def _render(self, first: bool) -> None:
+        w = self._out
+        if not first:
+            w.write(f"\x1b[{len(self.choices)}A")  # cursor up to re-render in place
+        for i in range(len(self.choices)):
+            w.write("\x1b[2K" + self._line(i) + "\n")
+        w.flush()
+
+    def step(self, key: str) -> bool:
+        """Apply one key event; True when the selection is finalized."""
+        if key == ENTER:
+            return True
+        if key == INTERRUPT:
+            raise KeyboardInterrupt
+        if key in (UP, "k"):
+            self.index = (self.index - 1) % len(self.choices)
+        elif key in (DOWN, "j"):
+            self.index = (self.index + 1) % len(self.choices)
+        elif key.isdigit() and int(key) < len(self.choices):
+            self.index = int(key)
+        return False
+
+    def run(self) -> int:
+        self._out.write(self.prompt + " (arrows + Enter):\n")
+        self._render(first=True)
+        while True:
+            done = self.step(self._read())
+            if done:
+                return self.index
+            self._render(first=False)
+
+
+def choose(
+    prompt: str,
+    choices: Sequence[str],
+    default: str,
+    key_reader: Callable[[], str] | None = None,
+) -> str:
+    """Menu when interactive, numbered-input fallback otherwise; returns the
+    chosen *value*. The questionnaire's one entry point."""
+    default_index = choices.index(default) if default in choices else 0
+    interactive = key_reader is not None or (
+        sys.stdin.isatty() and sys.stdout.isatty() and _termios_available()
+    )
+    if interactive:
+        raw_mode_errors: tuple = (OSError, ValueError)
+        if _termios_available():
+            import termios
+
+            raw_mode_errors += (termios.error,)  # subclasses Exception, not OSError
+        try:
+            idx = SelectionMenu(prompt, choices, default_index, key_reader=key_reader).run()
+            return choices[idx]
+        except raw_mode_errors:
+            pass  # raw mode unavailable after all — fall through
+    listing = ", ".join(f"{i}={c}" for i, c in enumerate(choices))
+    raw = input(f"{prompt} [{listing}] ({default}): ").strip()
+    if raw.isdigit() and int(raw) < len(choices):
+        return choices[int(raw)]
+    if raw in choices:
+        return raw
+    if raw:
+        print(f"  invalid choice {raw!r}, using {default}")
+    return default
+
+
+def _termios_available() -> bool:
+    try:
+        import termios  # noqa: F401
+        import tty  # noqa: F401
+
+        return True
+    except ImportError:
+        return False

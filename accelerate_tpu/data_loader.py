@@ -26,6 +26,7 @@ collate/workers) or with any python iterable yielding numpy/dict batches.
 from __future__ import annotations
 
 import math
+from collections.abc import Mapping
 from typing import Any, Callable, Iterable, Iterator
 
 import jax
@@ -277,6 +278,13 @@ class _PrefetchIterator:
         self._lookahead = None
         self._primed = False
 
+    @property
+    def in_flight(self) -> int:
+        """Batches pulled from the underlying iterator but not yet yielded —
+        checkpoint state surgery subtracts these (reference
+        `data_loader.py:449` adjust_state_dict_for_prefetch)."""
+        return 1 if self._lookahead is not None else 0
+
     def __iter__(self):
         return self
 
@@ -296,6 +304,40 @@ class _PrefetchIterator:
         if current is None:
             raise StopIteration
         return current
+
+
+# counter keys a stateful loader snapshot uses for "batches already consumed":
+# torchdata StatefulDataLoader's snapshot tree plus our own test fixtures. Each
+# is decremented by the in-flight count so resume replays prefetched-but-unseen
+# batches instead of silently skipping them.
+_PREFETCH_ADJUST_KEYS = frozenset(
+    {"_snapshot_step", "_num_yielded", "samples_yielded", "_sampler_iter_yielded",
+     "_num_batches_fetched", "num_batches_yielded"}
+)
+
+
+def adjust_state_dict_for_prefetch(snapshot: Any, in_flight: int) -> Any:
+    """Rewind every batch-counter in a stateful loader's snapshot by the number
+    of batches the prefetch chain has pulled ahead of the training step
+    (reference `data_loader.py:449` ``adjust_state_dict_for_prefetch``). The
+    walk is structural: any nested mapping key in ``_PREFETCH_ADJUST_KEYS``
+    holding an int is decremented, clamped at 0, leaving the rest verbatim."""
+    if isinstance(snapshot, Mapping):
+        items = {
+            k: (
+                max(v - in_flight, 0)
+                if k in _PREFETCH_ADJUST_KEYS and isinstance(v, int)
+                else adjust_state_dict_for_prefetch(v, in_flight)
+            )
+            for k, v in snapshot.items()
+        }
+        try:
+            return type(snapshot)(items)
+        except TypeError:  # Mapping subtypes w/o dict ctor (defaultdict, ...)
+            return items
+    if isinstance(snapshot, (list, tuple)):
+        return type(snapshot)(adjust_state_dict_for_prefetch(v, in_flight) for v in snapshot)
+    return snapshot
 
 
 class DataLoaderShard:
@@ -425,14 +467,16 @@ class DataLoaderShard:
                 from .native import HostPrefetcher, is_native_available, native_unavailable_reason
 
                 if is_native_available():
-                    base_it = iter(
-                        HostPrefetcher(base_it, slot_bytes=self.prefetch_slot_bytes)
+                    self._live_host_prefetcher = HostPrefetcher(
+                        base_it, slot_bytes=self.prefetch_slot_bytes
                     )
+                    base_it = iter(self._live_host_prefetcher)
                 elif self.prefetch == "native":
                     raise RuntimeError(
                         f"prefetch='native' requested but {native_unavailable_reason()}"
                     )
             it = _PrefetchIterator(base_it, _mark_last)
+            self._live_prefetch_it = it
             for idx, batch in enumerate(it):
                 if idx < self.skip_batches:
                     continue
@@ -441,6 +485,19 @@ class DataLoaderShard:
         finally:
             self.gradient_state._remove_dataloader(self)
             self.skip_batches = 0
+            self._live_prefetch_it = None
+            self._live_host_prefetcher = None
+
+    def _in_flight_batches(self) -> int:
+        """Batches the prefetch chain has consumed from ``base_loader`` beyond
+        what this loader has yielded: the one-batch lookahead plus whatever the
+        native staging ring holds."""
+        n = 0
+        if getattr(self, "_live_prefetch_it", None) is not None:
+            n += self._live_prefetch_it.in_flight
+        if getattr(self, "_live_host_prefetcher", None) is not None:
+            n += self._live_host_prefetcher.in_flight
+        return n
 
     # ----------------------------------------------------- checkpoint support
     def state_dict(self) -> dict[str, Any]:
@@ -456,9 +513,16 @@ class DataLoaderShard:
         }
         if hasattr(self.base_loader, "state_dict"):
             try:
-                state["base_loader"] = self.base_loader.state_dict()
+                snapshot = self.base_loader.state_dict()
             except Exception:
-                pass
+                snapshot = None  # loader advertises state but can't produce it
+            if snapshot is not None:
+                # adjustment errors must propagate: swallowing them here would
+                # silently drop the whole snapshot and restart the dataset
+                in_flight = self._in_flight_batches()
+                if in_flight:
+                    snapshot = adjust_state_dict_for_prefetch(snapshot, in_flight)
+                state["base_loader"] = snapshot
         sampler = self.synchronized_generator
         if sampler is not None and hasattr(sampler, "epoch"):
             state["sampler_epoch"] = sampler.epoch

@@ -88,6 +88,12 @@ def notebook_launcher(
         raise RuntimeError(
             "notebook_launcher cannot nest inside an already-launched distributed job."
         )
+    if num_nodes > 1 and str(use_port) == "0":
+        raise ValueError(
+            "use_port='0' (ephemeral) would make each node pick a different "
+            "coordinator port and hang the rendezvous; pass an explicit port "
+            "for multi-node launches."
+        )
     if _jax_backends_initialized():
         raise RuntimeError(
             "JAX devices are already initialized in this process; forked workers "
@@ -99,6 +105,12 @@ def notebook_launcher(
     try:
         ctx = multiprocessing.get_context("fork")
     except ValueError:
+        if num_nodes > 1:
+            raise RuntimeError(
+                "multi-node notebook_launcher requires the fork start method "
+                "(unavailable on this OS); a single-node fallback would form a "
+                "wrong-sized world and hang the other nodes."
+            )
         # no fork on this OS: fall back to the importable-function spawn path
         debug_launcher(function, args=args, num_processes=num_processes, platform=None)
         return

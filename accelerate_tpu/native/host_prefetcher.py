@@ -54,12 +54,26 @@ class HostPrefetcher:
         self.base = base
         self.depth = max(depth, 2)
         self.slot_bytes = slot_bytes
+        self._consumed = 0  # producer thread: batches pulled from base
+        self._yielded = 0  # consumer side: batches handed out
+
+    @property
+    def in_flight(self) -> int:
+        """Batches staged in the ring but not yet yielded. Read between steps
+        for checkpoint state surgery; the producer advances concurrently, so
+        callers snapshot the base loader state BEFORE reading this (a late
+        increment then only over-rewinds, replaying a batch rather than
+        skipping one)."""
+        return max(self._consumed - self._yielded, 0)
 
     def __iter__(self) -> Iterator[Any]:
         from . import PrefetchRing, is_native_available
 
         if not is_native_available():
-            yield from self.base
+            for batch in self.base:
+                self._consumed += 1
+                self._yielded += 1
+                yield batch
             return
 
         ring = PrefetchRing(self.depth, self.slot_bytes)
@@ -69,7 +83,19 @@ class HostPrefetcher:
 
         def producer():
             try:
-                for batch in self.base:
+                it = iter(self.base)
+                while True:
+                    # count BEFORE pulling: a preemption between the base
+                    # loader advancing and the counter would otherwise
+                    # under-count in_flight and make a concurrent checkpoint
+                    # resume one batch too far (silent skip); over-counting
+                    # merely replays a batch
+                    self._consumed += 1
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        self._consumed -= 1
+                        break
                     leaves, rebuild = _flatten(batch)
                     if leaves is None:  # non-numeric leaves: not stageable
                         meta.put(("bypass", batch, None))
@@ -93,10 +119,12 @@ class HostPrefetcher:
                 if kind is _SENTINEL:
                     break
                 if kind == "bypass":
+                    self._yielded += 1
                     yield payload
                     continue
                 arrays, _ = ring.pop(payload, copy=True)
                 ring.release()  # owning copies made; recycle the slot now
+                self._yielded += 1
                 yield rebuild(arrays)
             if error:
                 raise error[0]

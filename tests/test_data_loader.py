@@ -250,6 +250,66 @@ def test_dataloader_state_dict_roundtrip():
     assert float(np.asarray(out[0])[0]) == 2.0
 
 
+class _FakeStatefulLoader:
+    """Stateful base loader à la torchdata StatefulDataLoader: counts batches
+    it has handed out and resumes from that count."""
+
+    def __init__(self, batches):
+        self.batches = batches
+        self._resume_from = 0
+        self.fetched = 0
+
+    def __iter__(self):
+        start = self._resume_from
+        self._resume_from = 0
+        for b in self.batches[start:]:
+            self.fetched += 1
+            yield b
+
+    def state_dict(self):
+        return {"_snapshot": {"_num_yielded": self.fetched}}
+
+    def load_state_dict(self, state):
+        self._resume_from = state["_snapshot"]["_num_yielded"]
+
+
+def test_stateful_loader_prefetch_state_surgery():
+    """The one-batch lookahead has consumed ahead of the training step; the
+    snapshot must be rewound by the in-flight count or resume skips batches
+    (reference `data_loader.py:449` adjust_state_dict_for_prefetch)."""
+    base = _FakeStatefulLoader([np.full((8,), i) for i in range(5)])
+    dl = DataLoaderShard(base)
+    it = iter(dl)
+    next(it), next(it)
+    # lookahead holds batch 2: base has fetched 3, user has seen 2
+    assert base.fetched == 3
+    state = dl.state_dict()
+    assert state["base_loader"]["_snapshot"]["_num_yielded"] == 2
+
+    base2 = _FakeStatefulLoader([np.full((8,), i) for i in range(5)])
+    dl2 = DataLoaderShard(base2)
+    dl2.load_state_dict(state)
+    out = list(dl2)
+    assert [float(np.asarray(b)[0]) for b in out] == [2.0, 3.0, 4.0]
+
+
+def test_adjust_state_dict_for_prefetch_structure():
+    from accelerate_tpu.data_loader import adjust_state_dict_for_prefetch
+
+    snap = {
+        "_snapshot": {"_snapshot_step": 7, "_main": {"_num_batches_fetched": 7}},
+        "worker_states": [{"samples_yielded": 14}, {"samples_yielded": 0}],
+        "untouched": {"epoch": 3, "_num_yielded": "not-an-int"},
+    }
+    got = adjust_state_dict_for_prefetch(snap, 2)
+    assert got["_snapshot"]["_snapshot_step"] == 5
+    assert got["_snapshot"]["_main"]["_num_batches_fetched"] == 5
+    assert got["worker_states"][0]["samples_yielded"] == 12
+    assert got["worker_states"][1]["samples_yielded"] == 0  # clamped
+    assert got["untouched"] == {"epoch": 3, "_num_yielded": "not-an-int"}
+    assert snap["_snapshot"]["_snapshot_step"] == 7  # input not mutated
+
+
 class TestTorchInterop:
     def test_prepare_torch_dataloader(self):
         import torch

@@ -36,6 +36,59 @@ class TestConfig:
         assert path.exists()
 
 
+class TestSelectionMenu:
+    """Arrow-key menu widget (reference `commands/menu/selection_menu.py` role),
+    driven by scripted keystrokes — no pty needed."""
+
+    def _run(self, keys, choices, default_index=0):
+        import io
+
+        from accelerate_tpu.commands.menu import SelectionMenu
+
+        it = iter(keys)
+        menu = SelectionMenu(
+            "pick", choices, default_index, key_reader=lambda: next(it), out=io.StringIO()
+        )
+        return menu.run()
+
+    def test_arrows_wrap_and_select(self):
+        from accelerate_tpu.commands.menu import DOWN, ENTER, UP
+
+        assert self._run([DOWN, DOWN, ENTER], ["a", "b", "c"]) == 2
+        assert self._run([UP, ENTER], ["a", "b", "c"]) == 2  # wraps to the end
+        assert self._run([DOWN, DOWN, DOWN, ENTER], ["a", "b", "c"]) == 0
+
+    def test_vim_keys_and_digit_jump(self):
+        from accelerate_tpu.commands.menu import ENTER
+
+        assert self._run(["j", "j", "k", ENTER], ["a", "b", "c"]) == 1
+        assert self._run(["2", ENTER], ["a", "b", "c"]) == 2
+        assert self._run(["9", ENTER], ["a", "b", "c"]) == 0  # out of range: ignored
+
+    def test_interrupt_raises(self):
+        from accelerate_tpu.commands.menu import INTERRUPT
+
+        with pytest.raises(KeyboardInterrupt):
+            self._run([INTERRUPT], ["a", "b"])
+
+    def test_choose_returns_value_via_menu(self):
+        from accelerate_tpu.commands.menu import DOWN, ENTER, choose
+
+        it = iter([DOWN, ENTER])
+        got = choose("mp", ["no", "bf16", "fp16"], "no", key_reader=lambda: next(it))
+        assert got == "bf16"
+
+    def test_choose_noninteractive_fallback(self, monkeypatch):
+        from accelerate_tpu.commands import menu
+
+        monkeypatch.setattr("builtins.input", lambda _: "1")
+        assert menu.choose("mp", ["no", "bf16"], "no") == "bf16"
+        monkeypatch.setattr("builtins.input", lambda _: "")
+        assert menu.choose("mp", ["no", "bf16"], "bf16") == "bf16"
+        monkeypatch.setattr("builtins.input", lambda _: "bogus")
+        assert menu.choose("mp", ["no", "bf16"], "no") == "no"
+
+
 class TestLaunchEnv:
     def test_env_contract(self):
         from accelerate_tpu.commands.config import LaunchConfig
