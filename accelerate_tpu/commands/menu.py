@@ -20,27 +20,30 @@ UP, DOWN, ENTER, INTERRUPT = "up", "down", "enter", "interrupt"
 def _read_key(stream=None) -> str:
     """Block for one keypress on the controlling terminal and decode it to a
     key event or a literal character. Raw mode spans exactly one key so ^C
-    remains deliverable between keys."""
+    remains deliverable between keys. Bytes come via ``os.read`` on the fd —
+    a buffered ``stream.read(1)`` would slurp the whole ESC sequence into the
+    TextIOWrapper buffer, the select() poll would then miss the tail, and
+    arrow navigation would silently die."""
+    import os
+    import select
     import termios
     import tty
-
-    import select
 
     stream = stream or sys.stdin
     fd = stream.fileno()
     saved = termios.tcgetattr(fd)
     try:
         tty.setraw(fd)
-        ch = stream.read(1)
+        ch = os.read(fd, 1).decode(errors="replace")
         if ch == "\x1b":  # escape sequence: arrows are ESC [ A/B
             # a bare Esc press has no tail — poll so it doesn't block the menu
             # (and later keystrokes aren't eaten as a phantom escape tail)
-            tail = ""
+            tail = b""
             while len(tail) < 2 and select.select([fd], [], [], 0.05)[0]:
-                tail += stream.read(1)
-            if tail in ("[A", "OA"):
+                tail += os.read(fd, 1)
+            if tail in (b"[A", b"OA"):
                 return UP
-            if tail in ("[B", "OB"):
+            if tail in (b"[B", b"OB"):
                 return DOWN
             return ""
         if ch in ("\r", "\n"):
@@ -122,20 +125,15 @@ def choose(
     """Menu when interactive, numbered-input fallback otherwise; returns the
     chosen *value*. The questionnaire's one entry point."""
     default_index = choices.index(default) if default in choices else 0
+    # Probe raw-mode availability up front instead of catching errors around
+    # the whole menu run — a broad catch there would mask real bugs (e.g. a
+    # key_reader raising ValueError) as a silent fallback.
     interactive = key_reader is not None or (
-        sys.stdin.isatty() and sys.stdout.isatty() and _termios_available()
+        sys.stdin.isatty() and sys.stdout.isatty() and _raw_mode_works()
     )
     if interactive:
-        raw_mode_errors: tuple = (OSError, ValueError)
-        if _termios_available():
-            import termios
-
-            raw_mode_errors += (termios.error,)  # subclasses Exception, not OSError
-        try:
-            idx = SelectionMenu(prompt, choices, default_index, key_reader=key_reader).run()
-            return choices[idx]
-        except raw_mode_errors:
-            pass  # raw mode unavailable after all — fall through
+        idx = SelectionMenu(prompt, choices, default_index, key_reader=key_reader).run()
+        return choices[idx]
     listing = ", ".join(f"{i}={c}" for i, c in enumerate(choices))
     raw = input(f"{prompt} [{listing}] ({default}): ").strip()
     if raw.isdigit() and int(raw) < len(choices):
@@ -147,11 +145,17 @@ def choose(
     return default
 
 
-def _termios_available() -> bool:
+def _raw_mode_works() -> bool:
+    """True when stdin's terminal actually supports raw mode — not just when
+    termios imports. termios.error subclasses Exception (not OSError), and
+    ValueError covers fileno() on detached streams."""
     try:
-        import termios  # noqa: F401
+        import termios
         import tty  # noqa: F401
-
-        return True
     except ImportError:
+        return False
+    try:
+        termios.tcgetattr(sys.stdin.fileno())
+        return True
+    except (OSError, ValueError, termios.error):
         return False

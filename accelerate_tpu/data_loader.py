@@ -306,38 +306,61 @@ class _PrefetchIterator:
         return current
 
 
-# counter keys a stateful loader snapshot uses for "batches already consumed":
-# torchdata StatefulDataLoader's snapshot tree plus our own test fixtures. Each
-# is decremented by the in-flight count so resume replays prefetched-but-unseen
-# batches instead of silently skipping them.
-_PREFETCH_ADJUST_KEYS = frozenset(
-    {"_snapshot_step", "_num_yielded", "samples_yielded", "_sampler_iter_yielded",
+# counter keys a stateful loader snapshot uses for "already consumed", by UNIT:
+# batch-unit keys (torchdata StatefulDataLoader's snapshot tree plus our own
+# test fixtures) rewind by the in-flight batch count; sample-unit keys
+# (sampler positions) rewind by in_flight × batch_size. Mixing the units would
+# desync the sampler from the fetcher on resume.
+_PREFETCH_BATCH_KEYS = frozenset(
+    {"_snapshot_step", "_num_yielded", "_sampler_iter_yielded",
      "_num_batches_fetched", "num_batches_yielded"}
 )
+_PREFETCH_SAMPLE_KEYS = frozenset({"samples_yielded"})
 
 
-def adjust_state_dict_for_prefetch(snapshot: Any, in_flight: int) -> Any:
-    """Rewind every batch-counter in a stateful loader's snapshot by the number
-    of batches the prefetch chain has pulled ahead of the training step
+def adjust_state_dict_for_prefetch(
+    snapshot: Any, in_flight: int, batch_size: int | None = None
+) -> Any:
+    """Rewind every consumed-counter in a stateful loader's snapshot by the
+    number of batches the prefetch chain has pulled ahead of the training step
     (reference `data_loader.py:449` ``adjust_state_dict_for_prefetch``). The
-    walk is structural: any nested mapping key in ``_PREFETCH_ADJUST_KEYS``
-    holding an int is decremented, clamped at 0, leaving the rest verbatim."""
-    if isinstance(snapshot, Mapping):
-        items = {
-            k: (
-                max(v - in_flight, 0)
-                if k in _PREFETCH_ADJUST_KEYS and isinstance(v, int)
-                else adjust_state_dict_for_prefetch(v, in_flight)
-            )
-            for k, v in snapshot.items()
-        }
-        try:
-            return type(snapshot)(items)
-        except TypeError:  # Mapping subtypes w/o dict ctor (defaultdict, ...)
-            return items
-    if isinstance(snapshot, (list, tuple)):
-        return type(snapshot)(adjust_state_dict_for_prefetch(v, in_flight) for v in snapshot)
-    return snapshot
+    walk is structural: nested mapping keys in the batch-unit set are
+    decremented by ``in_flight``, sample-unit keys by
+    ``in_flight * batch_size``, all clamped at 0, rest verbatim. When
+    ``batch_size`` is unknown, sample-unit keys are left untouched and a
+    warning explains the possible sampler desync."""
+    sample_rewind = in_flight * batch_size if batch_size else None
+
+    def _walk(node: Any) -> Any:
+        if isinstance(node, Mapping):
+            items = {}
+            for k, v in node.items():
+                if k in _PREFETCH_BATCH_KEYS and isinstance(v, int):
+                    items[k] = max(v - in_flight, 0)
+                elif k in _PREFETCH_SAMPLE_KEYS and isinstance(v, int):
+                    if sample_rewind is None:
+                        import warnings
+
+                        warnings.warn(
+                            f"stateful loader snapshot has sample-unit counter {k!r} "
+                            "but the base loader exposes no batch_size; leaving it "
+                            "unadjusted may desync the sampler by up to "
+                            f"{in_flight} prefetched batch(es) on resume."
+                        )
+                        items[k] = v
+                    else:
+                        items[k] = max(v - sample_rewind, 0)
+                else:
+                    items[k] = _walk(v)
+            try:
+                return type(node)(items)
+            except TypeError:  # Mapping subtypes w/o dict ctor (defaultdict, ...)
+                return items
+        if isinstance(node, (list, tuple)):
+            return type(node)(_walk(v) for v in node)
+        return node
+
+    return _walk(snapshot)
 
 
 class DataLoaderShard:
@@ -521,7 +544,10 @@ class DataLoaderShard:
                 # silently drop the whole snapshot and restart the dataset
                 in_flight = self._in_flight_batches()
                 if in_flight:
-                    snapshot = adjust_state_dict_for_prefetch(snapshot, in_flight)
+                    snapshot = adjust_state_dict_for_prefetch(
+                        snapshot, in_flight,
+                        batch_size=getattr(self.base_loader, "batch_size", None),
+                    )
                 state["base_loader"] = snapshot
         sampler = self.synchronized_generator
         if sampler is not None and hasattr(sampler, "epoch"):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterable, Iterator
 
 import jax
@@ -77,7 +78,10 @@ class HostPrefetcher:
             return
 
         ring = PrefetchRing(self.depth, self.slot_bytes)
-        meta: "queue.Queue" = queue.Queue()
+        # bounded: bypass batches skip ring.push (the ring's own backpressure),
+        # so without a maxsize a dataset of non-stageable batches would be
+        # drained wholesale into memory ahead of the consumer
+        meta: "queue.Queue" = queue.Queue(maxsize=self.depth + 2)
         _SENTINEL = object()
         error: list[BaseException] = []
 
@@ -132,6 +136,14 @@ class HostPrefetcher:
             # stop first: the producer may be blocked inside ring_push_batch, and
             # destroying the ring under it would be a use-after-free
             ring.stop()
+            # the producer may also be blocked on the bounded meta queue
+            # (early consumer exit): drain until it can finish
+            deadline = time.monotonic() + 5
+            while t.is_alive() and time.monotonic() < deadline:
+                try:
+                    meta.get_nowait()
+                except queue.Empty:
+                    time.sleep(0.01)
             t.join(timeout=5)
             if t.is_alive():
                 ring._h = None  # leak rather than free under a live thread

@@ -253,6 +253,243 @@ def _bwd(causal, block_q, block_kv, interpret, residuals, dout):
     return dq, dk, dv
 
 
+# ------------------------------------------------- causal lower-triangle grid
+# For causal self-attention the rectangular grid wastes cells: above-diagonal
+# blocks are skipped by predication but still fetched and iterated, and at
+# s == block (one cell per (b, h)) half the computed logits are masked. This
+# path linearizes the *lower triangle only* into the last grid dimension and
+# routes block indices through scalar-prefetched maps (the splash-attention
+# idiom): T = nq(nq+1)/2 cells instead of nq², and the mask is applied only on
+# diagonal blocks. Requires sq == skv and square blocks.
+
+
+def _triangle_maps(nq: int):
+    """Row-major triangle enumeration: (0,0),(1,0),(1,1),(2,0)… — kv index
+    innermost so the fwd/dq accumulators run init(ik=0)→flush(ik=iq)."""
+    import numpy as np
+
+    pairs = [(iq, ik) for iq in range(nq) for ik in range(iq + 1)]
+    iq_map = np.asarray([p[0] for p in pairs], np.int32)
+    ik_map = np.asarray([p[1] for p in pairs], np.int32)
+    return iq_map, ik_map
+
+
+def _triangle_maps_col(nq: int):
+    """Column-major enumeration: (0,0),(0,1)…(0,nq-1),(1,1)… — q index
+    innermost so the dkv accumulators run init(iq=ik)→flush(iq=nq-1)."""
+    import numpy as np
+
+    pairs = [(ik, iq) for ik in range(nq) for iq in range(ik, nq)]
+    ik_map = np.asarray([p[0] for p in pairs], np.int32)
+    iq_map = np.asarray([p[1] for p in pairs], np.int32)
+    return iq_map, ik_map
+
+
+def _tri_logits(q, k, iq, ik, block_q, block_kv):
+    """QK^T for one triangle cell, masked only when the cell straddles the
+    causal boundary (ik == iq) — shared by all three triangle kernels so the
+    masking rule cannot drift between forward and backward."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_idx = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where((ik == iq) & (k_idx > q_idx), NEG_INF, s)
+
+
+def _fwd_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, block_q, block_kv):
+    t = pl.program_id(2)
+    iq, ik = iqm[t], ikm[t]
+
+    @pl.when(ik == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    correction = jnp.exp(m_prev - m_new)
+    l_scr[:, :1] = correction * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+    acc[:] = acc[:] * correction + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ik == iq)
+    def _():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(safe_l), lse_ref.shape[2:])
+
+
+def _dq_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, block_q, block_kv):
+    t = pl.program_id(2)
+    iq, ik = iqm[t], ikm[t]
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]
+    delta = delta_ref[0, 0][:, :1]
+    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_acc[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ik == iq)
+    def _():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_kv, nq):
+    t = pl.program_id(2)
+    iq, ik = iqm[t], ikm[t]
+
+    @pl.when(iq == ik)  # first cell of this kv column
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0][:, :1]
+    delta = delta_ref[0, 0][:, :1]
+    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    p = jnp.exp(s - lse)
+    dv_acc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _tri_grid_spec(nq_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratch_shapes):
+    """PrefetchScalarGridSpec over the linearized triangle; q-indexed inputs use
+    iqm, kv-indexed use ikm (scalar-prefetch operands are the first two kernel
+    args). Scratch lives in the spec — pallas_call rejects it separately when a
+    grid_spec is given."""
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, t, iqm, ikm: (b_, h_, iqm[t], 0))
+    kv_spec = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, t, iqm, ikm: (b_, h_, ikm[t], 0))
+    row8 = pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, t, iqm, ikm: (b_, h_, iqm[t], 0))
+    per_input = {"q": q_spec, "kv": kv_spec, "row8": row8}
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nq_cells),
+        in_specs=[per_input[kind] for kind in n_in],
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+
+
+def _fwd_triangle(q, k, v, block, interpret):
+    b, h, sq, d = q.shape
+    nq = sq // block
+    iqm, ikm = _triangle_maps(nq)
+    grid_spec = _tri_grid_spec(
+        len(iqm), b, h, block, block, d, ["q", "kv", "kv"],
+        [
+            pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
+            pl.BlockSpec((1, 1, block, 8), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
+        ],
+        [
+            pltpu.VMEM((block, d), jnp.float32),
+            pltpu.VMEM((block, 128), jnp.float32),
+            pltpu.VMEM((block, 128), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_tri_kernel, block_q=block, block_kv=block),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
+        ],
+        interpret=interpret,
+    )(iqm, ikm, q, k, v)
+    return out, lse
+
+
+def _bwd_triangle(block, interpret, residuals, dout):
+    q, k, v, out, lse = residuals
+    b, h, sq, d = q.shape
+    nq = sq // block
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
+
+    iqm, ikm = _triangle_maps(nq)
+    dq = pl.pallas_call(
+        functools.partial(_dq_tri_kernel, block_q=block, block_kv=block),
+        grid_spec=_tri_grid_spec(
+            len(iqm), b, h, block, block, d,
+            ["q", "kv", "kv", "q", "row8", "row8"],
+            pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
+            [pltpu.VMEM((block, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(iqm, ikm, q, k, v, dout, lse, delta)
+
+    iqm2, ikm2 = _triangle_maps_col(nq)
+    kv_out = pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, ikm_[t], 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_tri_kernel, block_q=block, block_kv=block, nq=nq),
+        grid_spec=_tri_grid_spec(
+            len(iqm2), b, h, block, block, d,
+            ["q", "kv", "kv", "q", "row8", "row8"],
+            [kv_out, kv_out],
+            [
+                pltpu.VMEM((block, d), jnp.float32),
+                pltpu.VMEM((block, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(iqm2, ikm2, q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_tri(q, k, v, block, interpret):
+    out, _ = _fwd_triangle(q, k, v, block, interpret)
+    return out
+
+
+def _flash_tri_fwd(q, k, v, block, interpret):
+    out, lse = _fwd_triangle(q, k, v, block, interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash_tri.defvjp(_flash_tri_fwd, _bwd_triangle)
+
+
 # ------------------------------------------------------------------ public API
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_kv, interpret):
@@ -275,7 +512,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def _env_block(name: str, default: int) -> int:
     import os
 
-    return int(os.environ.get(name, default))
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
 
 
 def flash_attention(
@@ -287,6 +525,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int | None = None,
     block_kv: int | None = None,
+    triangle_block: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] inputs.
@@ -295,24 +534,34 @@ def flash_attention(
     used NATIVELY when it is a multiple of the 8-sublane width (64 for GPT-2
     class models — Mosaic lane-pads in VMEM, HBM moves only real bytes);
     other head dims are zero-padded up to the next multiple of 128.
+
+    ``triangle_block`` (or env ``ACCELERATE_TPU_FLASH_TRIANGLE=<block>``)
+    switches causal self-attention onto the lower-triangle grid: only
+    at-or-below-diagonal blocks exist as grid cells, halving attention
+    FLOPs/fetches at large seq vs the rectangular grid's predication skip.
     """
     b, sq, hn, d = q.shape
     skv = k.shape[1]
     if interpret is None:
         interpret = not _on_tpu()
     scale = 1.0 / math.sqrt(d) if scale is None else scale
-    # Block defaults are env-tunable for sweeps (ACCELERATE_TPU_FLASH_BLOCK_*).
-    # 1024×1024 won the round-3 sweep (docs/PERF_NOTES.md): at s<=1024 the whole
-    # (b,h) attention runs in ONE grid cell, and the [block_q, block_kv] fp32
-    # logits tile (4 MB) still fits VMEM comfortably; longer sequences fall
-    # back to 1024-wide tiles.
-    block_q = _env_block("ACCELERATE_TPU_FLASH_BLOCK_Q", 1024) if block_q is None else block_q
-    block_kv = _env_block("ACCELERATE_TPU_FLASH_BLOCK_KV", 1024) if block_kv is None else block_kv
-    block_q = min(block_q, sq)
-    block_kv = min(block_kv, skv)
-    if sq % block_q or skv % block_kv:
-        raise ValueError(f"seq lengths ({sq}, {skv}) must divide block sizes ({block_q}, {block_kv})")
-    # transpose to [B, H, S, D]
+    # An EXPLICIT triangle_block is a strict request: reject configurations it
+    # cannot serve rather than silently measuring the rectangular kernel. The
+    # env knob is a global default (cross-attention in the same model must
+    # still work), so it falls back silently instead.
+    if triangle_block is not None:
+        if not causal or sq != skv:
+            raise ValueError(
+                "triangle_block applies only to causal self-attention (sq == skv); "
+                f"got causal={causal}, sq={sq}, skv={skv}"
+            )
+        if block_q is not None or block_kv is not None:
+            raise ValueError("triangle_block and block_q/block_kv are mutually exclusive")
+        if sq % min(triangle_block, sq):
+            raise ValueError(f"seq {sq} must divide triangle_block {triangle_block}")
+    else:
+        triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or None
+
     qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
@@ -320,7 +569,24 @@ def flash_attention(
     if d_pad:
         pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
         qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
-    out = _flash(qt, kt, vt, causal, block_q, block_kv, interpret)
+
+    if causal and triangle_block and sq == skv and sq % min(triangle_block, sq) == 0:
+        out = _flash_tri(qt, kt, vt, min(triangle_block, sq), interpret)
+    else:
+        # Block defaults are env-tunable for sweeps (ACCELERATE_TPU_FLASH_BLOCK_*).
+        # 1024×1024 won the round-3 sweep (docs/PERF_NOTES.md): at s<=1024 the
+        # whole (b,h) attention runs in ONE grid cell, and the [block_q, block_kv]
+        # fp32 logits tile (4 MB) still fits VMEM comfortably; longer sequences
+        # fall back to 1024-wide tiles.
+        block_q = _env_block("ACCELERATE_TPU_FLASH_BLOCK_Q", 1024) if block_q is None else block_q
+        block_kv = _env_block("ACCELERATE_TPU_FLASH_BLOCK_KV", 1024) if block_kv is None else block_kv
+        block_q = min(block_q, sq)
+        block_kv = min(block_kv, skv)
+        if sq % block_q or skv % block_kv:
+            raise ValueError(
+                f"seq lengths ({sq}, {skv}) must divide block sizes ({block_q}, {block_kv})"
+            )
+        out = _flash(qt, kt, vt, causal, block_q, block_kv, interpret)
     if d_pad:
         out = out[..., :d]
     return jnp.transpose(out, (0, 2, 1, 3))

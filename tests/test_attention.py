@@ -58,3 +58,65 @@ def test_flash_rejects_indivisible():
     q = _rand(shape, 9)
     with pytest.raises(ValueError):
         flash_attention(q, q, q, block_q=64, block_kv=64)
+
+
+@pytest.mark.parametrize("shape,block", [((2, 128, 4, 64), 32), ((1, 256, 2, 32), 64)])
+def test_flash_triangle_matches_xla_forward(shape, block):
+    """Lower-triangle causal grid (scalar-prefetch block maps) vs XLA."""
+    b, s, h, d = shape
+    q, k, v = _rand(shape, 0), _rand(shape, 1), _rand(shape, 2)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, triangle_block=block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_triangle_gradients_match():
+    shape = (1, 128, 2, 32)
+    q, k, v = _rand(shape, 3), _rand(shape, 4), _rand(shape, 5)
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_tri(q, k, v):
+        return (flash_attention(q, k, v, causal=True, triangle_block=32) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_tri = jax.grad(loss_tri, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_tri, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
+
+
+def test_flash_triangle_single_block_and_env(monkeypatch):
+    """block == seq degenerates to one diagonal cell per (b, h); env knob routes."""
+    shape = (1, 64, 2, 32)
+    q, k, v = _rand(shape, 6), _rand(shape, 7), _rand(shape, 8)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, triangle_block=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    monkeypatch.setenv("ACCELERATE_TPU_FLASH_TRIANGLE", "32")
+    out_env = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_env), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_triangle_explicit_arg_is_strict():
+    """An explicit triangle_block must error on configs it can't serve —
+    silently measuring the rectangular kernel would poison perf sweeps."""
+    q = _rand((1, 64, 2, 32), 9)
+    kx = _rand((1, 128, 2, 32), 10)
+    with pytest.raises(ValueError, match="causal self-attention"):
+        flash_attention(q, kx, kx, causal=False, triangle_block=32)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        flash_attention(q, q, q, causal=True, triangle_block=32, block_q=32)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, q, q, causal=True, triangle_block=48)
+
+
+def test_flash_triangle_env_knob_falls_back_for_cross_attention(monkeypatch):
+    """The env knob is a global default: cross-attention in the same model must
+    silently keep the rectangular path."""
+    monkeypatch.setenv("ACCELERATE_TPU_FLASH_TRIANGLE", "32")
+    q = _rand((1, 64, 2, 32), 9)
+    k = v = _rand((1, 128, 2, 32), 10)
+    ref = dot_product_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, block_q=32, block_kv=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

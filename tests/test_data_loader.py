@@ -298,16 +298,27 @@ def test_adjust_state_dict_for_prefetch_structure():
 
     snap = {
         "_snapshot": {"_snapshot_step": 7, "_main": {"_num_batches_fetched": 7}},
-        "worker_states": [{"samples_yielded": 14}, {"samples_yielded": 0}],
+        "worker_states": [{"samples_yielded": 14}, {"samples_yielded": 3}],
         "untouched": {"epoch": 3, "_num_yielded": "not-an-int"},
     }
-    got = adjust_state_dict_for_prefetch(snap, 2)
+    # batch-unit keys rewind by batches; sample-unit keys by batches*batch_size
+    got = adjust_state_dict_for_prefetch(snap, 2, batch_size=5)
     assert got["_snapshot"]["_snapshot_step"] == 5
     assert got["_snapshot"]["_main"]["_num_batches_fetched"] == 5
-    assert got["worker_states"][0]["samples_yielded"] == 12
+    assert got["worker_states"][0]["samples_yielded"] == 4  # 14 - 2*5
     assert got["worker_states"][1]["samples_yielded"] == 0  # clamped
     assert got["untouched"] == {"epoch": 3, "_num_yielded": "not-an-int"}
     assert snap["_snapshot"]["_snapshot_step"] == 7  # input not mutated
+
+    # unknown batch_size: sample-unit counters are left alone, with a warning
+    import warnings as w
+
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        got2 = adjust_state_dict_for_prefetch(snap, 2)
+    assert got2["worker_states"][0]["samples_yielded"] == 14
+    assert got2["_snapshot"]["_snapshot_step"] == 5
+    assert any("sample-unit" in str(c.message) for c in caught)
 
 
 class TestTorchInterop:

@@ -336,7 +336,7 @@ def test_notebook_launcher_restarts_failed_generation(tmp_path):
                 if state.is_main_process:
                     open({str(marker)!r}, "w").write("x")
                 raise RuntimeError("induced first-generation failure")
-        notebook_launcher(train, num_processes=2, use_port="0", max_restarts=1)
+        notebook_launcher(train, num_processes=2, use_port="0", max_restarts=2)
     """
     res = _run_notebook_sim(textwrap.dedent(body), tmp_path)
     assert res.returncode == 0, res.stderr[-2000:]
