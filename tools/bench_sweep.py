@@ -1,0 +1,95 @@
+"""Relay-safe sequential bench sweep.
+
+The axon relay wedges when device processes run back-to-back or die mid-op
+(docs/PERF_NOTES.md "Relay/session operational model"). This driver encodes
+those rules: a short-timeout probe before every run, >=90 s settle between
+runs, a cool-down wait after any failure, and one JSON line per config
+appended to the output file so a later wedge can't lose earlier results.
+
+Usage: python tools/bench_sweep.py [out.jsonl]
+Configs come from SWEEP below; edit freely — each entry is the env overlay
+for one `python bench.py` run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+SETTLE_S = 90
+COOLDOWN_S = 600
+PROBE_TIMEOUT_S = 120
+
+SWEEP: list[dict[str, str]] = [
+    {},  # current default (round-3 landed config)
+    {"BENCH_FUSED_CE": "2"},
+    {"ACCELERATE_TPU_FLASH_TRIANGLE": "512"},
+    {"ACCELERATE_TPU_FLASH_TRIANGLE": "256"},
+    {"ACCELERATE_TPU_FLASH_TRIANGLE": "512", "BENCH_FUSED_CE": "2"},
+    {"BENCH_MODEL": "medium", "BENCH_FUSED_CE": "2"},
+    {"BENCH_MODEL": "medium", "BENCH_FUSED_CE": "2", "ACCELERATE_TPU_FLASH_TRIANGLE": "512"},
+    {"BENCH_MODEL": "medium"},
+    {"BENCH_SCAN": "1"},
+    {"BENCH_REMAT": "dots"},
+]
+
+
+def probe() -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; (jax.numpy.ones(8) * 2).block_until_ready(); print('ok')"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        return out.returncode == 0 and "ok" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench_sweep.jsonl"
+    for i, overlay in enumerate(SWEEP):
+        label = json.dumps(overlay, sort_keys=True)
+        if not probe():
+            print(f"[sweep] relay unreachable before config {label}; "
+                  f"cooling down {COOLDOWN_S}s", flush=True)
+            time.sleep(COOLDOWN_S)
+            if not probe():
+                print("[sweep] still unreachable; aborting (results so far kept)",
+                      flush=True)
+                return
+        time.sleep(SETTLE_S)  # probe itself was a device process
+        env = dict(os.environ)
+        env.update(overlay)
+        print(f"[sweep] run {i + 1}/{len(SWEEP)}: {label}", flush=True)
+        try:
+            run = subprocess.run(
+                [sys.executable, "bench.py"], env=env,
+                capture_output=True, text=True, timeout=900,
+            )
+            line = run.stdout.strip().splitlines()[-1] if run.stdout.strip() else ""
+        except subprocess.TimeoutExpired:
+            # do NOT SIGKILL again — bench's own watchdog should have fired;
+            # reaching this means it didn't get the chance
+            line = ""
+        rec = {"config": overlay}
+        try:
+            rec.update(json.loads(line))
+        except (json.JSONDecodeError, ValueError):
+            rec["error"] = "no-json" if not line else f"unparseable: {line[:200]}"
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[sweep] -> {json.dumps(rec)[:220]}", flush=True)
+        if "error" in rec or rec.get("value") in (None, 0, 0.0):
+            print(f"[sweep] failure; cooling down {COOLDOWN_S}s", flush=True)
+            time.sleep(COOLDOWN_S)
+        else:
+            time.sleep(SETTLE_S)
+    print(f"[sweep] done -> {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,13 +25,24 @@ def main() -> None:
     import optax
 
     from accelerate_tpu.accelerator import Accelerator
-    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, lm_loss_fn, lm_loss_fn_fused
+    from accelerate_tpu.models.gpt2 import (
+        GPT2Config,
+        GPT2LMHead,
+        lm_loss_fn,
+        lm_loss_fn_fused,
+        lm_loss_fn_pallas,
+    )
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     remat = os.environ.get("BENCH_REMAT", "")
-    cfg = (GPT2Config.small if on_tpu else GPT2Config.tiny)(
+    model_name = os.environ.get("BENCH_MODEL", "small")
+    if on_tpu:
+        cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
+    else:
+        cfg_cls = GPT2Config.tiny
+    cfg = cfg_cls(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         attention_impl=attn, scan_layers=scan, remat=bool(remat), remat_policy=remat or None,
     )
@@ -42,10 +53,13 @@ def main() -> None:
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
     model, opt = acc.prepare((module, params), optax.adamw(1e-4))
-    if os.environ.get("BENCH_FUSED_CE", "0") == "1":
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0")
+    if fused_ce == "1":
         import functools
 
         loss = functools.partial(lm_loss_fn_fused, chunk=int(os.environ.get("BENCH_CE_CHUNK", 1024)))
+    elif fused_ce == "2":
+        loss = lm_loss_fn_pallas
     else:
         loss = lm_loss_fn
     step = acc.make_train_step(loss)
