@@ -84,6 +84,7 @@ def _debug_cpu_launch(
     module: bool = False,
     max_restarts: int = 0,
     monitor_interval: float = 0.5,
+    devices_per_process: int = 1,
 ) -> int:
     """Fork n local JAX 'hosts' over a localhost coordinator (CPU platform).
 
@@ -93,6 +94,8 @@ def _debug_cpu_launch(
     its child, and the new generation re-forms at the SAME coordinator address
     — jax.distributed's barrier is the rendezvous. Each generation reads
     ``ACCELERATE_TPU_RESTART_COUNT`` and resumes from the latest checkpoint.
+    ``devices_per_process`` > 1 gives each host that many virtual chips — a
+    pod-slice topology (N hosts × M chips) without hardware.
     """
     import socket
     import time
@@ -115,6 +118,10 @@ def _debug_cpu_launch(
                 "ACCELERATE_TPU_RESTART_COUNT": str(restarts),
             }
         )
+        if devices_per_process > 1:
+            from ..launchers import set_host_device_count_flag
+
+            set_host_device_count_flag(env, devices_per_process)
         return subprocess.Popen(_child_command(script, script_args, module), env=env)
 
     restarts = 0
@@ -237,6 +244,7 @@ def launch_command(args: argparse.Namespace) -> None:
             module=args.module,
             max_restarts=args.max_restarts,
             monitor_interval=args.monitor_interval,
+            devices_per_process=args.devices_per_process,
         )
         sys.exit(rc)
     if args.max_restarts:
@@ -269,6 +277,9 @@ def add_parser(subparsers) -> None:
     p.add_argument("--debug", action="store_true", help="enable collective shape verification")
     p.add_argument("--debug_cpu", type=int, default=None, metavar="N",
                    help="fork N local CPU 'hosts' over a localhost coordinator")
+    p.add_argument("--devices_per_process", type=int, default=1, metavar="M",
+                   help="with --debug_cpu: give each host M virtual chips "
+                        "(rehearse an N-host x M-chip pod slice without hardware)")
     p.add_argument("--max_restarts", type=int, default=0,
                    help="restart the script on failure up to N times "
                         "(torchelastic analogue; resume via load_state)")

@@ -28,6 +28,18 @@ import traceback
 from typing import Callable
 
 
+def set_host_device_count_flag(env: dict, n_devices: int) -> None:
+    """Point a child's ``XLA_FLAGS`` at ``n_devices`` virtual host-CPU chips,
+    replacing any existing count flag — the one place this flag is spelled for
+    child envs (the CLI launcher and debug_launcher both route here)."""
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+
+
 def _jax_backends_initialized() -> bool:
     """True once this process has materialized any XLA backend. Forking after
     that point hands children dead device handles (the reference's analogous
@@ -224,12 +236,7 @@ def debug_launcher(
             if platform == "cpu":
                 env["PALLAS_AXON_POOL_IPS"] = ""
         if devices_per_process > 1:
-            flags = [
-                f for f in env.get("XLA_FLAGS", "").split()
-                if not f.startswith("--xla_force_host_platform_device_count")
-            ]
-            flags.append(f"--xla_force_host_platform_device_count={devices_per_process}")
-            env["XLA_FLAGS"] = " ".join(flags)
+            set_host_device_count_flag(env, devices_per_process)
         procs.append(subprocess.Popen([sys.executable, "-c", runner], env=env))
     codes = [p.wait() for p in procs]
     if any(codes):

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Multi-host launch on a Cloud TPU pod slice — the TPU-native analogue of the
+# reference's examples/slurm/submit_multinode.sh (same role: show the exact
+# incantation that turns N machines into one training job).
+#
+# One process per TPU VM host owns all of that host's chips (SPMD); there is
+# no per-core forking and no RANK/MASTER_ADDR plumbing. On Cloud TPU,
+# jax.distributed discovers the coordinator from the TPU metadata, so the env
+# contract below is only needed off-GCP or to override.
+#
+# Usage: ./launch_pod.sh <tpu-name> <zone> <script.py> [script args...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+SCRIPT=${3:?training script}
+shift 3
+
+# `accelerate-tpu tpu-config` wraps: gcloud compute tpus tpu-vm ssh $TPU_NAME
+#   --zone $ZONE --worker=all --command "accelerate-tpu launch $SCRIPT ..."
+exec accelerate-tpu tpu-config \
+  --tpu_name "$TPU_NAME" \
+  --zone "$ZONE" \
+  --command "cd \$(dirname $SCRIPT) && accelerate-tpu launch $SCRIPT $*"

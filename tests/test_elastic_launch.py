@@ -135,3 +135,25 @@ def test_multihost_generation_restart(tmp_path):
     assert (tmp_path / "gen.0").read_text() == "1"
     assert (tmp_path / "gen.1").read_text() == "1"
     assert "restart 1/2" in out.stderr
+
+
+POD_SLICE = """
+import jax, sys
+from accelerate_tpu.state import PartialState
+s = PartialState()
+assert s.num_processes == 2, s.num_processes
+assert jax.local_device_count() == 4, jax.local_device_count()
+assert jax.device_count() == 8, jax.device_count()
+print(f"host {s.process_index} sees 4 local / 8 global")
+"""
+
+
+def test_debug_cpu_devices_per_process(tmp_path):
+    """--debug_cpu N --devices_per_process M rehearses an N-host x M-chip pod
+    slice without hardware (examples/tpu_pod/README.md recipe)."""
+    out = _launch(
+        tmp_path,
+        ["--debug_cpu", "2", "--devices_per_process", "4"],
+        POD_SLICE,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]

@@ -65,16 +65,19 @@ def main() -> None:
         env = dict(os.environ)
         env.update(overlay)
         print(f"[sweep] run {i + 1}/{len(SWEEP)}: {label}", flush=True)
+        bench_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
         try:
             run = subprocess.run(
-                [sys.executable, "bench.py"], env=env,
+                [sys.executable, bench_path], env=env,
                 capture_output=True, text=True, timeout=900,
             )
             line = run.stdout.strip().splitlines()[-1] if run.stdout.strip() else ""
-        except subprocess.TimeoutExpired:
-            # do NOT SIGKILL again — bench's own watchdog should have fired;
-            # reaching this means it didn't get the chance
-            line = ""
+        except subprocess.TimeoutExpired as exc:
+            # bench may have emitted its result line and then hung in backend
+            # teardown before subprocess.run's SIGKILL — keep what it printed
+            out = (exc.stdout or b"")
+            out = out.decode(errors="replace") if isinstance(out, bytes) else out
+            line = out.strip().splitlines()[-1] if out.strip() else ""
         rec = {"config": overlay}
         try:
             rec.update(json.loads(line))

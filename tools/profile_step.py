@@ -39,7 +39,10 @@ def main() -> None:
     remat = os.environ.get("BENCH_REMAT", "")
     model_name = os.environ.get("BENCH_MODEL", "small")
     if on_tpu:
-        cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
+        cfg_cls = getattr(GPT2Config, model_name, None)
+        if cfg_cls is None:
+            sys.exit(f"BENCH_MODEL={model_name!r}: no such GPT2Config preset "
+                     "(try small/medium/large)")
     else:
         cfg_cls = GPT2Config.tiny
     cfg = cfg_cls(
