@@ -309,12 +309,25 @@ def lm_loss_fn_fused(model, batch, chunk: int = 1024) -> jax.Array:
     return chunked_cross_entropy(hidden.reshape(b * s, e), wte, labels.reshape(b * s), chunk=chunk)
 
 
-def lm_loss_fn_pallas(model, batch, block_r: int = 512, block_v: int = 2048) -> jax.Array:
+def lm_loss_fn_pallas(model, batch, block_r: int | None = None, block_v: int | None = None) -> jax.Array:
     """Next-token LM loss through the Pallas fused head+CE kernel
     (`ops/fused_ce.py`): logits tiles live only in VMEM, row chunks run as
-    parallel grid cells (no scan serialization). Drop-in for `lm_loss_fn`."""
+    parallel grid cells (no scan serialization). Drop-in for `lm_loss_fn`.
+    Block sizes default from ``ACCELERATE_TPU_FUSED_CE_BLOCK_R/_V`` (sweepable;
+    larger models need smaller tiles — the dw kernel's VMEM footprint scales
+    with block_v*e)."""
+    import os
+
     from ..ops.fused_ce import fused_cross_entropy
 
+    def _env(name, default):
+        raw = os.environ.get(name, "").strip()
+        return int(raw) if raw else default
+
+    if block_r is None:
+        block_r = _env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
+    if block_v is None:
+        block_v = _env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 2048)
     hidden = model(batch["input_ids"], return_hidden=True)
     labels = _next_token_labels(batch)
     b, s, e = hidden.shape

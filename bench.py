@@ -200,7 +200,12 @@ def main() -> None:
     module = GPT2LMHead(cfg)
     state["stage"] = "init_params"
     params = module.init_params(jax.random.key(0), batch=batch, seq=seq)
-    model, opt = acc.prepare((module, params), optax.adamw(1e-4))
+    # BENCH_MU_DTYPE=bfloat16 halves the AdamW first-moment HBM traffic (optax
+    # mu_dtype); second moment stays fp32
+    mu_dtype = os.environ.get("BENCH_MU_DTYPE") or None
+    if mu_dtype == "bf16":  # accept the common shorthand; optax needs the full name
+        mu_dtype = "bfloat16"
+    model, opt = acc.prepare((module, params), optax.adamw(1e-4, mu_dtype=mu_dtype))
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0")
     if fused_ce == "1":
         import functools

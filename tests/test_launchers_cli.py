@@ -382,3 +382,28 @@ def test_performance_script():
     finally:
         os.environ.clear()
         os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_big_model_inference_bench_smoke(tmp_path):
+    """tools/bench_inference.py (the reference's headline big-model-inference
+    flow: sharded safetensors -> device -> KV-cache decode) runs end-to-end on
+    the tiny preset and emits its one JSON line."""
+    import json
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": str(REPO) + os.pathsep + env.get("PYTHONPATH", ""),
+        "BENCH_INF_PRESET": "tiny", "BENCH_INF_TOKENS": "4",
+        "BENCH_INF_CKPT": str(tmp_path / "ckpt"),
+    })
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "bench_inference.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "big_model_inference"
+    assert rec["detail"]["load_s"] > 0
+    assert rec["detail"]["s_per_token"] > 0

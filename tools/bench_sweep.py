@@ -34,6 +34,9 @@ SWEEP: list[dict[str, str]] = [
     {"BENCH_MODEL": "medium"},
     {"BENCH_SCAN": "1"},
     {"BENCH_REMAT": "dots"},
+    {"BENCH_MU_DTYPE": "bfloat16"},
+    {"BENCH_MU_DTYPE": "bfloat16", "BENCH_FUSED_CE": "2",
+     "ACCELERATE_TPU_FLASH_TRIANGLE": "512"},
 ]
 
 
