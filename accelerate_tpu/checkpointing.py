@@ -81,6 +81,26 @@ def _restore_pytree(path: Path, target: Any | None = None) -> Any:
         return ckptr.restore(path.absolute(), abstract)
 
 
+def _restore_pytree_host(path: Path) -> Any:
+    """Topology-independent restore: rebuild the abstract tree from the
+    checkpoint's own metadata with NO shardings, so a checkpoint written by an
+    N-process mesh consolidates on a single host — the merge-weights path
+    (reference `utils/fsdp_utils.py:274` merge_fsdp_weights role). A plain
+    ``restore(path)`` would try to re-materialize the saved device topology
+    and fail off-cluster."""
+    ocp = _ocp()
+    host = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    with ocp.StandardCheckpointer() as ckptr:
+        meta = ckptr.metadata(path.absolute()).item_metadata.tree
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, np.dtype(m.dtype), sharding=host)
+            if hasattr(m, "shape")
+            else m,
+            meta,
+        )
+        return ckptr.restore(path.absolute(), abstract)
+
+
 def _save_host_state(path: Path, obj: Any) -> None:
     if PartialState().is_main_process:
         with open(path, "wb") as f:
@@ -189,26 +209,27 @@ def save_model_weights(
     save_directory: str,
     max_shard_size: str | int = "10GB",
     safe_serialization: bool = True,
-) -> None:
+) -> list[str]:
     """Consolidated (unsharded) model export for interchange (reference
     `save_model`, `accelerator.py:2804-2919`), written by process 0:
     sharded ``.safetensors`` + index with tied-weight dedup by default, or flax
     msgpack with ``safe_serialization=False``. Counterpart of the sharded orbax
     layout above."""
     if not PartialState().is_main_process:
-        return
+        return []
     os.makedirs(save_directory, exist_ok=True)
     if safe_serialization:
         from .utils.safetensors_io import save_safetensors_checkpoint
 
-        save_safetensors_checkpoint(state_dict, save_directory, max_shard_size=max_shard_size)
-        return
+        return save_safetensors_checkpoint(state_dict, save_directory, max_shard_size=max_shard_size)
     from flax import serialization
 
     as_np = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state_dict)
     payload = serialization.msgpack_serialize(as_np)
-    with open(Path(save_directory) / "model.msgpack", "wb") as f:
+    out = Path(save_directory) / "model.msgpack"
+    with open(out, "wb") as f:
         f.write(payload)
+    return [str(out)]
 
 
 def load_model_weights(save_directory: str) -> Any:

@@ -12,11 +12,12 @@ from pathlib import Path
 
 
 def merge_command(args: argparse.Namespace) -> None:
-    from ..checkpointing import _restore_pytree, save_model_weights
+    from ..checkpointing import _restore_pytree_host, save_model_weights
 
-    tree = _restore_pytree(Path(args.checkpoint_dir))
-    save_model_weights(tree, args.output_dir)
-    print(f"Merged {args.checkpoint_dir} -> {Path(args.output_dir) / 'model.msgpack'}")
+    tree = _restore_pytree_host(Path(args.checkpoint_dir))
+    written = save_model_weights(tree, args.output_dir)
+    names = ", ".join(Path(f).name for f in written) if isinstance(written, (list, tuple)) else Path(str(written)).name
+    print(f"Merged {args.checkpoint_dir} -> {args.output_dir} ({names})")
 
 
 def add_parser(subparsers) -> None:

@@ -316,18 +316,13 @@ def lm_loss_fn_pallas(model, batch, block_r: int | None = None, block_v: int | N
     Block sizes default from ``ACCELERATE_TPU_FUSED_CE_BLOCK_R/_V`` (sweepable;
     larger models need smaller tiles — the dw kernel's VMEM footprint scales
     with block_v*e)."""
-    import os
-
     from ..ops.fused_ce import fused_cross_entropy
-
-    def _env(name, default):
-        raw = os.environ.get(name, "").strip()
-        return int(raw) if raw else default
+    from ..utils.environment import parse_int_from_env
 
     if block_r is None:
-        block_r = _env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
+        block_r = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
     if block_v is None:
-        block_v = _env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 2048)
+        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 2048)
     hidden = model(batch["input_ids"], return_hidden=True)
     labels = _next_token_labels(batch)
     b, s, e = hidden.shape

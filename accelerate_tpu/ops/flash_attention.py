@@ -510,10 +510,9 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _env_block(name: str, default: int) -> int:
-    import os
+    from ..utils.environment import parse_int_from_env
 
-    raw = os.environ.get(name, "").strip()
-    return int(raw) if raw else default
+    return parse_int_from_env(name, default)
 
 
 def flash_attention(

@@ -1,0 +1,80 @@
+"""Gradient-compression comm hooks across 2 real JAX processes (reference
+`test_utils/scripts/test_ddp_comm_hook.py` role): every hook must (a) keep
+replicas bit-identical after each update — the DDP invariant the hooks must
+not break — and (b) still train to (near-)baseline quality. Run under
+`debug_launcher`; each process is one data-parallel replica."""
+
+
+def _setup():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    W = rng.normal(size=(8, 8)).astype(np.float32)
+    batches = [
+        {"x": (x := rng.normal(size=(16, 8)).astype(np.float32)), "y": x @ W}
+        for _ in range(24)
+    ]
+    params = {"w": np.zeros((8, 8), np.float32)}
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    def loss_fn(m, b):
+        return ((m(b["x"]) - b["y"]) ** 2).mean()
+
+    return params, apply_fn, loss_fn, batches
+
+
+def _train(comm_hook):
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    params, apply_fn, loss_fn, batches = _setup()
+    acc = Accelerator()
+    model, opt, dl = acc.prepare(
+        (apply_fn, params), optax.adam(0.1), DataLoaderShard(batches)
+    )
+    step = acc.make_train_step(loss_fn, comm_hook=comm_hook)
+    losses = [float(step(b)) for b in dl]
+    final = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), acc.get_state_dict(model))
+    return final, losses
+
+
+def run_checks():
+    import numpy as np
+
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import operations
+
+    state = PartialState()
+    assert state.num_processes == 2, state.num_processes
+
+    results = {}
+    for hook in (None, "bf16", "power_sgd"):
+        final, losses = _train(hook)
+        assert losses[-1] < losses[0] / 3, (hook, losses[0], losses[-1])
+        # DDP invariant: replicas hold identical params after every update
+        gathered = operations.gather_object([final["w"].sum().item()])
+        assert abs(gathered[0] - gathered[1]) < 1e-6, (hook, gathered)
+        results[hook] = final["w"]
+
+    # bf16 compression rounds the wire format only: near-baseline updates
+    bf16_err = np.abs(results["bf16"] - results[None]).max()
+    assert bf16_err < 0.05, bf16_err
+    # powersgd is rank-limited but error feedback must keep it training toward
+    # the same solution
+    psgd_err = np.abs(results["power_sgd"] - results[None]).max()
+    assert psgd_err < 0.5, psgd_err
+    if state.is_main_process:
+        print(f"comm hooks OK: bf16 max dev {bf16_err:.4f}, power_sgd {psgd_err:.4f}")
+
+
+if __name__ == "__main__":
+    run_checks()

@@ -1,0 +1,57 @@
+"""Sharded save for the merge-weights flow, on 2 real JAX processes (reference
+`test_utils/scripts/test_merge_weights.py` role, over the orbax/msgpack pair
+instead of torch.distributed.checkpoint). The launched phase only SAVES — the
+fsdp-sharded model checkpoints via `save_state`, every process writing its
+shards. The merge itself (`accelerate-tpu merge-weights`) is a single-process
+CLI by design (orbax restore has global barriers, so it cannot run on a
+subset of a live multi-process world); the caller runs it afterwards and
+verifies against `expected_params()`.
+"""
+
+
+def expected_params():
+    """Deterministic params both the launched world and the verifying caller
+    can reconstruct."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    return {
+        "w1": rng.normal(size=(16, 8)).astype(np.float32),
+        "w2": rng.normal(size=(8, 4)).astype(np.float32),
+    }
+
+
+def run_checks(workdir):
+    from pathlib import Path
+
+    import jax
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    assert state.num_processes == 2, state.num_processes
+    workdir = Path(workdir)
+
+    def apply_fn(p, x):
+        return jax.numpy.tanh(x @ p["w1"]) @ p["w2"]
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(fsdp_size=2))
+    model, opt = acc.prepare((apply_fn, expected_params()), optax.sgd(0.1))
+    # every leaf must actually be sharded over the fsdp axis for the merge to
+    # prove consolidation
+    for leaf in jax.tree.leaves(model.params):
+        assert not leaf.sharding.is_fully_replicated, leaf.sharding
+    acc.save_state(workdir / "ckpt")
+    acc.wait_for_everyone()
+    if state.is_main_process:
+        assert (workdir / "ckpt" / "model_0").exists()
+        print("sharded save OK: ready for single-process merge")
+
+
+if __name__ == "__main__":
+    import sys
+
+    run_checks(sys.argv[1])

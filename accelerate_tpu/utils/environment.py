@@ -38,6 +38,13 @@ def parse_choice_from_env(key: str, default: str = "no") -> str:
     return os.environ.get(key, str(default))
 
 
+def parse_int_from_env(key: str, default: int) -> int:
+    """Integer env knob; empty/whitespace values fall back to the default
+    (kernel block sizes, sweep knobs)."""
+    raw = os.environ.get(key, "").strip()
+    return int(raw) if raw else default
+
+
 def get_int_from_env(keys: list[str], default: int) -> int:
     """Return the first set integer among ``keys`` (reference: same helper for PMI/OMPI)."""
     for key in keys:

@@ -407,3 +407,51 @@ def test_big_model_inference_bench_smoke(tmp_path):
     assert rec["metric"] == "big_model_inference"
     assert rec["detail"]["load_s"] > 0
     assert rec["detail"]["s_per_token"] > 0
+
+
+@pytest.mark.slow
+def test_comm_hooks_script():
+    """Tier-2: compression comm hooks keep replicas identical and training
+    convergent on 2 real JAX processes (reference test_ddp_comm_hook.py role)."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_comm_hooks
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_comm_hooks.run_checks, num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_merge_weights_script(tmp_path):
+    """Tier-2: 2-process fsdp-sharded save, then the single-process
+    merge-weights CLI consolidates to full params (reference
+    test_merge_weights.py role)."""
+    import argparse
+
+    import numpy as np
+
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_merge_weights
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_merge_weights.run_checks, args=(str(tmp_path),), num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+    from accelerate_tpu.checkpointing import load_model_weights
+    from accelerate_tpu.commands.merge import merge_command
+
+    merge_command(argparse.Namespace(
+        checkpoint_dir=str(tmp_path / "ckpt" / "model_0"),
+        output_dir=str(tmp_path / "merged"),
+    ))
+    merged = load_model_weights(tmp_path / "merged")
+    for k, v in test_merge_weights.expected_params().items():
+        np.testing.assert_allclose(np.asarray(merged[k]), v, atol=1e-6)

@@ -103,7 +103,7 @@ def main() -> None:
         "detail": {
             "preset": preset,
             "params_b": round(n_params / 1e9, 3),
-            "load_s": round(load_s, 2),
+            "load_s": round(load_s, 4),
             "s_per_token": round(s_per_token, 5),
             "new_tokens": tokens,
             "platform": jax.devices()[0].platform,
