@@ -41,6 +41,7 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
+    sliding_window: int | None = None  # Mistral-class: query i sees keys in (i-W, i]
 
     @classmethod
     def llama2_7b(cls, **kw) -> "LlamaConfig":
@@ -124,11 +125,14 @@ class LlamaAttention(nn.Module):
                 k_rep = jnp.repeat(k_all, groups, axis=2)
                 v_rep = jnp.repeat(v_all, groups, axis=2)
                 q_pos = idx + jnp.arange(s)[:, None]
-                mask = jnp.arange(max_len)[None, :] <= q_pos
+                k_idx = jnp.arange(max_len)[None, :]
+                mask = k_idx <= q_pos
+                if cfg.sliding_window is not None:
+                    mask = mask & (k_idx > q_pos - cfg.sliding_window)
                 out = attention(q, k_rep, v_rep, causal=False, mask=mask, implementation="xla")
             else:
                 out = attention(q, jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2),
-                                causal=True, implementation="xla")
+                                causal=True, window=cfg.sliding_window, implementation="xla")
         else:
             k = jnp.repeat(k, groups, axis=2)
             v = jnp.repeat(v, groups, axis=2)
@@ -136,9 +140,16 @@ class LlamaAttention(nn.Module):
                 from ..parallel.ring_attention import ring_attention_sharded
                 from ..state import AcceleratorState
 
+                if cfg.sliding_window is not None:
+                    raise NotImplementedError(
+                        "sliding_window is not implemented on the ring-attention path; "
+                        "silently computing full causal attention would train the "
+                        "wrong pattern. Use attention_impl='flash' (band grid) or 'xla'."
+                    )
                 out = ring_attention_sharded(q, k, v, AcceleratorState().mesh, causal=True)
             else:
-                out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
+                out = attention(q, k, v, causal=True, window=cfg.sliding_window,
+                                implementation=cfg.attention_impl)
         out = out.reshape(b, s, e)
         return dense(e, "o_proj")(out)
 

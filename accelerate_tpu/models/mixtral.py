@@ -50,6 +50,7 @@ class MixtralConfig:
     remat: bool = False
     remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
+    sliding_window: int | None = None  # HF MixtralConfig.sliding_window role
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
@@ -77,6 +78,7 @@ class MixtralConfig:
             param_dtype=self.param_dtype,
             remat=self.remat,
             attention_impl=self.attention_impl,
+            sliding_window=self.sliding_window,
         )
 
 

@@ -39,6 +39,7 @@ def dot_product_attention(
     bias: jax.Array | None = None,
     mask: jax.Array | None = None,  # boolean [B, 1|H, Sq, Sk] or [Sq, Sk], True=keep
     causal: bool = False,
+    window: int | None = None,  # sliding window: query i sees keys in (i-W, i]
     scale: float | None = None,
     dropout_rate: float = 0.0,
     dropout_rng: jax.Array | None = None,
@@ -55,6 +56,16 @@ def dot_product_attention(
         logits = logits + bias.astype(jnp.float32)
     if causal:
         logits = logits + causal_mask(q.shape[1], k.shape[1])[None, None, :, :]
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "window requires causal=True (one rule across xla and flash paths; "
+                "a low-side-only band would silently attend future keys)"
+            )
+        q_idx = jnp.arange(q.shape[1])[:, None]
+        k_idx = jnp.arange(k.shape[1])[None, :]
+        in_band = k_idx > q_idx - window
+        logits = jnp.where(in_band[None, None], logits, jnp.finfo(jnp.float32).min)
     if mask is not None:
         if mask.ndim == 2:
             mask = mask[None, None, :, :]
@@ -74,6 +85,7 @@ def attention(
     *,
     causal: bool = False,
     mask: jax.Array | None = None,
+    window: int | None = None,
     implementation: str = "auto",
     block_q: int | None = None,
     block_kv: int | None = None,
@@ -82,6 +94,8 @@ def attention(
 
     'auto' picks the Pallas flash kernel on TPU for sequences where the
     O(S^2) logits buffer dominates HBM traffic, else the fused XLA path.
+    ``window`` is Mistral-class sliding-window attention: on the flash path it
+    runs on the band grid (compute scales with the window, not seq^2).
     """
     if implementation == "auto":
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
@@ -89,5 +103,7 @@ def attention(
     if implementation == "flash":
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_kv=block_kv)
-    return dot_product_attention(q, k, v, causal=causal, mask=mask)
+        return flash_attention(
+            q, k, v, causal=causal, window=window, block_q=block_q, block_kv=block_kv
+        )
+    return dot_product_attention(q, k, v, causal=causal, mask=mask, window=window)

@@ -253,55 +253,87 @@ def _bwd(causal, block_q, block_kv, interpret, residuals, dout):
     return dq, dk, dv
 
 
-# ------------------------------------------------- causal lower-triangle grid
+# ------------------------------------------- causal band (lower-triangle) grid
 # For causal self-attention the rectangular grid wastes cells: above-diagonal
-# blocks are skipped by predication but still fetched and iterated, and at
+# blocks are predication-skipped but still fetched and iterated, and at
 # s == block (one cell per (b, h)) half the computed logits are masked. This
-# path linearizes the *lower triangle only* into the last grid dimension and
-# routes block indices through scalar-prefetched maps (the splash-attention
-# idiom): T = nq(nq+1)/2 cells instead of nq², and the mask is applied only on
-# diagonal blocks. Requires sq == skv and square blocks.
+# path enumerates ONLY the blocks inside the causal band into the last grid
+# dimension, with block indices and first/last flags routed through
+# scalar-prefetched maps (the splash-attention idiom). With window=None the
+# band is the full lower triangle (T = nq(nq+1)/2 cells instead of nq^2, mask
+# only on diagonal cells); with a sliding window W the band narrows to
+# ~ceil(W/block)+1 cells per row, so compute scales with W, not seq^2 —
+# Mistral-class sliding-window attention at native cost. Requires sq == skv
+# and square blocks.
 
 
-def _triangle_maps(nq: int):
-    """Row-major triangle enumeration: (0,0),(1,0),(1,1),(2,0)… — kv index
-    innermost so the fwd/dq accumulators run init(ik=0)→flush(ik=iq)."""
+def _band_lo(iq: int, block: int, window: int | None) -> int:
+    """Lowest kv block index row ``iq`` attends to (0 for pure causal)."""
+    if window is None:
+        return 0
+    return max(0, (iq * block - window + 1) // block)
+
+
+def _band_maps_row(nq: int, block: int, window: int | None):
+    """Row-major band enumeration — kv index innermost so the fwd/dq
+    accumulators run init(first-in-row) -> flush(last-in-row = diagonal)."""
     import numpy as np
 
-    pairs = [(iq, ik) for iq in range(nq) for ik in range(iq + 1)]
-    iq_map = np.asarray([p[0] for p in pairs], np.int32)
-    ik_map = np.asarray([p[1] for p in pairs], np.int32)
-    return iq_map, ik_map
+    pairs = [
+        (iq, ik) for iq in range(nq) for ik in range(_band_lo(iq, block, window), iq + 1)
+    ]
+    iqm = np.asarray([p[0] for p in pairs], np.int32)
+    ikm = np.asarray([p[1] for p in pairs], np.int32)
+    first = np.asarray(
+        [1 if ik == _band_lo(iq, block, window) else 0 for iq, ik in pairs], np.int32
+    )
+    last = np.asarray([1 if ik == iq else 0 for iq, ik in pairs], np.int32)
+    return iqm, ikm, first, last
 
 
-def _triangle_maps_col(nq: int):
-    """Column-major enumeration: (0,0),(0,1)…(0,nq-1),(1,1)… — q index
-    innermost so the dkv accumulators run init(iq=ik)→flush(iq=nq-1)."""
+def _band_maps_col(nq: int, block: int, window: int | None):
+    """Column-major band enumeration — q index innermost so the dkv
+    accumulators run init(first-in-column = diagonal) -> flush(last-in-column)."""
     import numpy as np
 
-    pairs = [(ik, iq) for ik in range(nq) for iq in range(ik, nq)]
-    ik_map = np.asarray([p[0] for p in pairs], np.int32)
-    iq_map = np.asarray([p[1] for p in pairs], np.int32)
-    return iq_map, ik_map
+    pairs = [
+        (iq, ik)
+        for ik in range(nq)
+        for iq in range(ik, nq)
+        if ik >= _band_lo(iq, block, window)
+    ]
+    iqm = np.asarray([p[0] for p in pairs], np.int32)
+    ikm = np.asarray([p[1] for p in pairs], np.int32)
+    cols = [p[1] for p in pairs]
+    first = np.asarray([1 if p[0] == p[1] else 0 for p in pairs], np.int32)
+    last = np.asarray(
+        [1 if i + 1 == len(pairs) or cols[i + 1] != cols[i] else 0 for i in range(len(pairs))],
+        np.int32,
+    )
+    return iqm, ikm, first, last
 
 
-def _tri_logits(q, k, iq, ik, block_q, block_kv):
-    """QK^T for one triangle cell, masked only when the cell straddles the
-    causal boundary (ik == iq) — shared by all three triangle kernels so the
-    masking rule cannot drift between forward and backward."""
+def _band_logits(q, k, iq, ik, block_q, block_kv, window):
+    """QK^T for one band cell, masked per the causal(+window) rule — shared by
+    all three band kernels so the masking cannot drift between forward and
+    backward. Pure causal masks only diagonal cells; a sliding window also
+    masks the low side (edge cells overhang the band by up to a block)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_idx = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where((ik == iq) & (k_idx > q_idx), NEG_INF, s)
+    if window is None:
+        return jnp.where((ik == iq) & (k_idx > q_idx), NEG_INF, s)
+    bad = (k_idx > q_idx) | (k_idx < q_idx - (window - 1))
+    return jnp.where(bad, NEG_INF, s)
 
 
-def _fwd_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, block_q, block_kv):
+def _fwd_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *, block_q, block_kv, window):
     t = pl.program_id(2)
     iq, ik = iqm[t], ikm[t]
 
-    @pl.when(ik == 0)
+    @pl.when(first[t] == 1)
     def _():
         acc[:] = jnp.zeros_like(acc)
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
@@ -310,7 +342,7 @@ def _fwd_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
-    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    s = _band_logits(q, k, iq, ik, block_q, block_kv, window)
     m_prev = m_scr[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -321,7 +353,7 @@ def _fwd_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l
     )
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
-    @pl.when(ik == iq)
+    @pl.when(last[t] == 1)
     def _():
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -329,11 +361,11 @@ def _fwd_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l
         lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(safe_l), lse_ref.shape[2:])
 
 
-def _dq_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, block_q, block_kv):
+def _dq_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc, *, block_q, block_kv, window):
     t = pl.program_id(2)
     iq, ik = iqm[t], ikm[t]
 
-    @pl.when(ik == 0)
+    @pl.when(first[t] == 1)
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
@@ -343,7 +375,7 @@ def _dq_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq
     do = do_ref[0, 0]
     lse = lse_ref[0, 0][:, :1]
     delta = delta_ref[0, 0][:, :1]
-    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    s = _band_logits(q, k, iq, ik, block_q, block_kv, window)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
@@ -351,16 +383,16 @@ def _dq_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
-    @pl.when(ik == iq)
+    @pl.when(last[t] == 1)
     def _():
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_kv, nq):
+def _dkv_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_kv, window):
     t = pl.program_id(2)
     iq, ik = iqm[t], ikm[t]
 
-    @pl.when(iq == ik)  # first cell of this kv column
+    @pl.when(first[t] == 1)  # first cell of this kv column (the diagonal)
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -371,7 +403,7 @@ def _dkv_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, d
     do = do_ref[0, 0]
     lse = lse_ref[0, 0][:, :1]
     delta = delta_ref[0, 0][:, :1]
-    s = _tri_logits(q, k, iq, ik, block_q, block_kv)
+    s = _band_logits(q, k, iq, ik, block_q, block_kv, window)
     p = jnp.exp(s - lse)
     dv_acc[:] += jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -382,39 +414,59 @@ def _dkv_tri_kernel(iqm, ikm, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, d
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
-    @pl.when(iq == nq - 1)
+    @pl.when(last[t] == 1)
     def _():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _tri_grid_spec(nq_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratch_shapes):
-    """PrefetchScalarGridSpec over the linearized triangle; q-indexed inputs use
-    iqm, kv-indexed use ikm (scalar-prefetch operands are the first two kernel
+def _band_grid_spec(n_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratch_shapes):
+    """PrefetchScalarGridSpec over the linearized band; q-indexed inputs use
+    iqm, kv-indexed use ikm (the four scalar-prefetch operands lead the kernel
     args). Scratch lives in the spec — pallas_call rejects it separately when a
     grid_spec is given."""
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, t, iqm, ikm: (b_, h_, iqm[t], 0))
-    kv_spec = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, t, iqm, ikm: (b_, h_, ikm[t], 0))
-    row8 = pl.BlockSpec((1, 1, block_q, 8), lambda b_, h_, t, iqm, ikm: (b_, h_, iqm[t], 0))
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_kv, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, ikm[t], 0)
+    )
+    row8 = pl.BlockSpec(
+        (1, 1, block_q, 8), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
+    )
     per_input = {"q": q_spec, "kv": kv_spec, "row8": row8}
     return pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, h, nq_cells),
+        num_scalar_prefetch=4,
+        grid=(b, h, n_cells),
         in_specs=[per_input[kind] for kind in n_in],
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
 
 
-def _fwd_triangle(q, k, v, block, interpret):
+def _q_out_spec(block, d):
+    return pl.BlockSpec(
+        (1, 1, block, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
+    )
+
+
+def _kv_out_spec(block, d):
+    return pl.BlockSpec(
+        (1, 1, block, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, ikm[t], 0)
+    )
+
+
+def _fwd_band(q, k, v, block, window, interpret):
     b, h, sq, d = q.shape
     nq = sq // block
-    iqm, ikm = _triangle_maps(nq)
-    grid_spec = _tri_grid_spec(
-        len(iqm), b, h, block, block, d, ["q", "kv", "kv"],
+    maps = _band_maps_row(nq, block, window)
+    grid_spec = _band_grid_spec(
+        len(maps[0]), b, h, block, block, d, ["q", "kv", "kv"],
         [
-            pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
-            pl.BlockSpec((1, 1, block, 8), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
+            _q_out_spec(block, d),
+            pl.BlockSpec(
+                (1, 1, block, 8), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
+            ),
         ],
         [
             pltpu.VMEM((block, d), jnp.float32),
@@ -423,45 +475,44 @@ def _fwd_triangle(q, k, v, block, interpret):
         ],
     )
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_tri_kernel, block_q=block, block_kv=block),
+        functools.partial(_fwd_band_kernel, block_q=block, block_kv=block, window=window),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, 8), jnp.float32),
         ],
         interpret=interpret,
-    )(iqm, ikm, q, k, v)
+    )(*maps, q, k, v)
     return out, lse
 
 
-def _bwd_triangle(block, interpret, residuals, dout):
+def _bwd_band(block, window, interpret, residuals, dout):
     q, k, v, out, lse = residuals
     b, h, sq, d = q.shape
     nq = sq // block
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
 
-    iqm, ikm = _triangle_maps(nq)
+    maps = _band_maps_row(nq, block, window)
     dq = pl.pallas_call(
-        functools.partial(_dq_tri_kernel, block_q=block, block_kv=block),
-        grid_spec=_tri_grid_spec(
-            len(iqm), b, h, block, block, d,
+        functools.partial(_dq_band_kernel, block_q=block, block_kv=block, window=window),
+        grid_spec=_band_grid_spec(
+            len(maps[0]), b, h, block, block, d,
             ["q", "kv", "kv", "q", "row8", "row8"],
-            pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, iqm_[t], 0)),
+            _q_out_spec(block, d),
             [pltpu.VMEM((block, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(iqm, ikm, q, k, v, dout, lse, delta)
+    )(*maps, q, k, v, dout, lse, delta)
 
-    iqm2, ikm2 = _triangle_maps_col(nq)
-    kv_out = pl.BlockSpec((1, 1, block, d), lambda b_, h_, t, iqm_, ikm_: (b_, h_, ikm_[t], 0))
+    maps2 = _band_maps_col(nq, block, window)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_tri_kernel, block_q=block, block_kv=block, nq=nq),
-        grid_spec=_tri_grid_spec(
-            len(iqm2), b, h, block, block, d,
+        functools.partial(_dkv_band_kernel, block_q=block, block_kv=block, window=window),
+        grid_spec=_band_grid_spec(
+            len(maps2[0]), b, h, block, block, d,
             ["q", "kv", "kv", "q", "row8", "row8"],
-            [kv_out, kv_out],
+            [_kv_out_spec(block, d), _kv_out_spec(block, d)],
             [
                 pltpu.VMEM((block, d), jnp.float32),
                 pltpu.VMEM((block, d), jnp.float32),
@@ -472,22 +523,22 @@ def _bwd_triangle(block, interpret, residuals, dout):
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=interpret,
-    )(iqm2, ikm2, q, k, v, dout, lse, delta)
+    )(*maps2, q, k, v, dout, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_tri(q, k, v, block, interpret):
-    out, _ = _fwd_triangle(q, k, v, block, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_band(q, k, v, block, window, interpret):
+    out, _ = _fwd_band(q, k, v, block, window, interpret)
     return out
 
 
-def _flash_tri_fwd(q, k, v, block, interpret):
-    out, lse = _fwd_triangle(q, k, v, block, interpret)
+def _flash_band_fwd(q, k, v, block, window, interpret):
+    out, lse = _fwd_band(q, k, v, block, window, interpret)
     return out, (q, k, v, out, lse)
 
 
-_flash_tri.defvjp(_flash_tri_fwd, _bwd_triangle)
+_flash_band.defvjp(_flash_band_fwd, _bwd_band)
 
 
 # ------------------------------------------------------------------ public API
@@ -525,6 +576,7 @@ def flash_attention(
     block_q: int | None = None,
     block_kv: int | None = None,
     triangle_block: int | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over [batch, seq, heads, head_dim] inputs.
@@ -535,15 +587,31 @@ def flash_attention(
     other head dims are zero-padded up to the next multiple of 128.
 
     ``triangle_block`` (or env ``ACCELERATE_TPU_FLASH_TRIANGLE=<block>``)
-    switches causal self-attention onto the lower-triangle grid: only
-    at-or-below-diagonal blocks exist as grid cells, halving attention
-    FLOPs/fetches at large seq vs the rectangular grid's predication skip.
+    switches causal self-attention onto the band grid: only blocks inside the
+    causal band exist as grid cells, halving attention FLOPs/fetches at large
+    seq vs the rectangular grid's predication skip. ``window=W`` (sliding
+    window: query i attends to keys in (i-W, i]) narrows the band so compute
+    scales with W rather than seq — Mistral-class attention; it requires the
+    band grid (``triangle_block``/env, defaulting to 512 when only ``window``
+    is given).
     """
     b, sq, hn, d = q.shape
     skv = k.shape[1]
     if interpret is None:
         interpret = not _on_tpu()
     scale = 1.0 / math.sqrt(d) if scale is None else scale
+    if window is not None:
+        if not causal or sq != skv:
+            raise ValueError(
+                "window applies only to causal self-attention (sq == skv); "
+                f"got causal={causal}, sq={sq}, skv={skv}"
+            )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if triangle_block is None:
+            triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or next(
+                b for b in range(min(512, sq), 0, -1) if sq % b == 0
+            )
     # An EXPLICIT triangle_block is a strict request: reject configurations it
     # cannot serve rather than silently measuring the rectangular kernel. The
     # env knob is a global default (cross-attention in the same model must
@@ -557,7 +625,9 @@ def flash_attention(
         if block_q is not None or block_kv is not None:
             raise ValueError("triangle_block and block_q/block_kv are mutually exclusive")
         if sq % min(triangle_block, sq):
-            raise ValueError(f"seq {sq} must divide triangle_block {triangle_block}")
+            raise ValueError(
+                f"triangle_block {triangle_block} must divide seq {sq}"
+            )
     else:
         triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or None
 
@@ -570,7 +640,7 @@ def flash_attention(
         qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
 
     if causal and triangle_block and sq == skv and sq % min(triangle_block, sq) == 0:
-        out = _flash_tri(qt, kt, vt, min(triangle_block, sq), interpret)
+        out = _flash_band(qt, kt, vt, min(triangle_block, sq), window, interpret)
     else:
         # Block defaults are env-tunable for sweeps (ACCELERATE_TPU_FLASH_BLOCK_*).
         # 1024×1024 won the round-3 sweep (docs/PERF_NOTES.md): at s<=1024 the
