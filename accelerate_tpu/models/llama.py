@@ -122,20 +122,18 @@ class LlamaAttention(nn.Module):
                 v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
                 cached_k.value, cached_v.value = k_all, v_all
                 cache_idx.value = idx + s
-                k_rep = jnp.repeat(k_all, groups, axis=2)
-                v_rep = jnp.repeat(v_all, groups, axis=2)
                 q_pos = idx + jnp.arange(s)[:, None]
                 k_idx = jnp.arange(max_len)[None, :]
                 mask = k_idx <= q_pos
                 if cfg.sliding_window is not None:
                     mask = mask & (k_idx > q_pos - cfg.sliding_window)
-                out = attention(q, k_rep, v_rep, causal=False, mask=mask, implementation="xla")
+                # GQA repeat happens inside attention()'s xla path — one source
+                # of truth with the training branches
+                out = attention(q, k_all, v_all, causal=False, mask=mask, implementation="xla")
             else:
-                out = attention(q, jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2),
-                                causal=True, window=cfg.sliding_window, implementation="xla")
+                out = attention(q, k, v, causal=True, window=cfg.sliding_window,
+                                implementation="xla")
         else:
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
             if cfg.attention_impl == "ring":
                 from ..parallel.ring_attention import ring_attention_sharded
                 from ..state import AcceleratorState
@@ -146,8 +144,13 @@ class LlamaAttention(nn.Module):
                         "silently computing full causal attention would train the "
                         "wrong pattern. Use attention_impl='flash' (band grid) or 'xla'."
                     )
-                out = ring_attention_sharded(q, k, v, AcceleratorState().mesh, causal=True)
+                out = ring_attention_sharded(
+                    q, jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2),
+                    AcceleratorState().mesh, causal=True,
+                )
             else:
+                # GQA K/V go through unrepeated; the flash band grid reads the
+                # grouped kv head directly and the xla path repeats internally
                 out = attention(q, k, v, causal=True, window=cfg.sliding_window,
                                 implementation=cfg.attention_impl)
         out = out.reshape(b, s, e)

@@ -97,13 +97,23 @@ def attention(
     ``window`` is Mistral-class sliding-window attention: on the flash path it
     runs on the band grid (compute scales with the window, not seq^2).
     """
+    if k.shape[2] != q.shape[2] and (k.shape[2] == 0 or q.shape[2] % k.shape[2]):
+        raise ValueError(
+            f"q heads ({q.shape[2]}) must be a multiple of kv heads ({k.shape[2]})"
+        )
     if implementation == "auto":
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
         implementation = "flash" if (on_tpu and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]) else "xla"
     if implementation == "flash":
         from .flash_attention import flash_attention
 
+        # GQA K/V pass through unrepeated — the band grid reads kv head
+        # h // groups directly; the rectangular path repeats internally
         return flash_attention(
             q, k, v, causal=causal, window=window, block_q=block_q, block_kv=block_kv
         )
+    if k.shape[2] != q.shape[2]:
+        groups = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     return dot_product_attention(q, k, v, causal=causal, mask=mask, window=window)

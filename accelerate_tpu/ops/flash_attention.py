@@ -291,26 +291,32 @@ def _band_maps_row(nq: int, block: int, window: int | None):
     return iqm, ikm, first, last
 
 
-def _band_maps_col(nq: int, block: int, window: int | None):
-    """Column-major band enumeration — q index innermost so the dkv
-    accumulators run init(first-in-column = diagonal) -> flush(last-in-column)."""
+def _band_maps_col(nq: int, block: int, window: int | None, groups: int = 1):
+    """Column-major band enumeration for the dkv pass: for each kv column the
+    sequential axis walks every (q-head-in-group, q-block) pair, so dk/dv
+    accumulate in KV-HEAD shape with no cross-cell races even under GQA.
+    init fires on the column's first pair, flush on its last."""
     import numpy as np
 
     pairs = [
-        (iq, ik)
+        (g, iq, ik)
         for ik in range(nq)
+        for g in range(groups)
         for iq in range(ik, nq)
         if ik >= _band_lo(iq, block, window)
     ]
-    iqm = np.asarray([p[0] for p in pairs], np.int32)
-    ikm = np.asarray([p[1] for p in pairs], np.int32)
-    cols = [p[1] for p in pairs]
-    first = np.asarray([1 if p[0] == p[1] else 0 for p in pairs], np.int32)
+    gm = np.asarray([p[0] for p in pairs], np.int32)
+    iqm = np.asarray([p[1] for p in pairs], np.int32)
+    ikm = np.asarray([p[2] for p in pairs], np.int32)
+    cols = [p[2] for p in pairs]
+    first = np.asarray(
+        [1 if i == 0 or cols[i - 1] != cols[i] else 0 for i in range(len(pairs))], np.int32
+    )
     last = np.asarray(
         [1 if i + 1 == len(pairs) or cols[i + 1] != cols[i] else 0 for i in range(len(pairs))],
         np.int32,
     )
-    return iqm, ikm, first, last
+    return iqm, ikm, gm, first, last
 
 
 def _band_logits(q, k, iq, ik, block_q, block_kv, window):
@@ -388,11 +394,11 @@ def _dq_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_kv, window):
+def _dkv_band_kernel(iqm, ikm, gm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_kv, window):
     t = pl.program_id(2)
     iq, ik = iqm[t], ikm[t]
 
-    @pl.when(first[t] == 1)  # first cell of this kv column (the diagonal)
+    @pl.when(first[t] == 1)  # first cell of this kv column
     def _():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -420,16 +426,19 @@ def _dkv_band_kernel(iqm, ikm, first, last, q_ref, k_ref, v_ref, do_ref, lse_ref
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _band_grid_spec(n_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratch_shapes):
+def _band_grid_spec(n_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratch_shapes, groups=1):
     """PrefetchScalarGridSpec over the linearized band; q-indexed inputs use
     iqm, kv-indexed use ikm (the four scalar-prefetch operands lead the kernel
-    args). Scratch lives in the spec — pallas_call rejects it separately when a
+    args). Under GQA (``groups`` > 1) the grid's head axis is the QUERY head
+    and kv blocks come from head ``h // groups`` — K/V are never repeated in
+    HBM. Scratch lives in the spec — pallas_call rejects it separately when a
     grid_spec is given."""
     q_spec = pl.BlockSpec(
         (1, 1, block_q, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
     )
     kv_spec = pl.BlockSpec(
-        (1, 1, block_kv, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, ikm[t], 0)
+        (1, 1, block_kv, d),
+        lambda b_, h_, t, iqm, ikm, first, last: (b_, h_ // groups, ikm[t], 0),
     )
     row8 = pl.BlockSpec(
         (1, 1, block_q, 8), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
@@ -444,20 +453,45 @@ def _band_grid_spec(n_cells, b, h, block_q, block_kv, d, n_in, out_specs, scratc
     )
 
 
+def _band_grid_spec_dkv(n_cells, b, hk, block, d, out_specs, scratch_shapes, groups=1):
+    """dkv-pass grid spec: head axis is the KV head; q-side inputs come from
+    query head ``h * groups + gm[t]`` (five scalar-prefetch operands)."""
+    q_spec = pl.BlockSpec(
+        (1, 1, block, d),
+        lambda b_, h_, t, iqm, ikm, gm, first, last: (b_, h_ * groups + gm[t], iqm[t], 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block, d), lambda b_, h_, t, iqm, ikm, gm, first, last: (b_, h_, ikm[t], 0)
+    )
+    row8 = pl.BlockSpec(
+        (1, 1, block, 8),
+        lambda b_, h_, t, iqm, ikm, gm, first, last: (b_, h_ * groups + gm[t], iqm[t], 0),
+    )
+    per_input = {"q": q_spec, "kv": kv_spec, "row8": row8}
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b, hk, n_cells),
+        in_specs=[per_input[kind] for kind in ["q", "kv", "kv", "q", "row8", "row8"]],
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+    )
+
+
 def _q_out_spec(block, d):
     return pl.BlockSpec(
         (1, 1, block, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, iqm[t], 0)
     )
 
 
-def _kv_out_spec(block, d):
+def _kv_out_spec_dkv(block, d):
     return pl.BlockSpec(
-        (1, 1, block, d), lambda b_, h_, t, iqm, ikm, first, last: (b_, h_, ikm[t], 0)
+        (1, 1, block, d), lambda b_, h_, t, iqm, ikm, gm, first, last: (b_, h_, ikm[t], 0)
     )
 
 
 def _fwd_band(q, k, v, block, window, interpret):
     b, h, sq, d = q.shape
+    groups = h // k.shape[1]
     nq = sq // block
     maps = _band_maps_row(nq, block, window)
     grid_spec = _band_grid_spec(
@@ -473,6 +507,7 @@ def _fwd_band(q, k, v, block, window, interpret):
             pltpu.VMEM((block, 128), jnp.float32),
             pltpu.VMEM((block, 128), jnp.float32),
         ],
+        groups=groups,
     )
     out, lse = pl.pallas_call(
         functools.partial(_fwd_band_kernel, block_q=block, block_kv=block, window=window),
@@ -489,6 +524,8 @@ def _fwd_band(q, k, v, block, window, interpret):
 def _bwd_band(block, window, interpret, residuals, dout):
     q, k, v, out, lse = residuals
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    groups = h // hk
     nq = sq // block
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (b, h, sq, 8))
@@ -501,22 +538,23 @@ def _bwd_band(block, window, interpret, residuals, dout):
             ["q", "kv", "kv", "q", "row8", "row8"],
             _q_out_spec(block, d),
             [pltpu.VMEM((block, d), jnp.float32)],
+            groups=groups,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
     )(*maps, q, k, v, dout, lse, delta)
 
-    maps2 = _band_maps_col(nq, block, window)
+    maps2 = _band_maps_col(nq, block, window, groups)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_band_kernel, block_q=block, block_kv=block, window=window),
-        grid_spec=_band_grid_spec(
-            len(maps2[0]), b, h, block, block, d,
-            ["q", "kv", "kv", "q", "row8", "row8"],
-            [_kv_out_spec(block, d), _kv_out_spec(block, d)],
+        grid_spec=_band_grid_spec_dkv(
+            len(maps2[0]), b, hk, block, d,
+            [_kv_out_spec_dkv(block, d), _kv_out_spec_dkv(block, d)],
             [
                 pltpu.VMEM((block, d), jnp.float32),
                 pltpu.VMEM((block, d), jnp.float32),
             ],
+            groups=groups,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -631,6 +669,10 @@ def flash_attention(
     else:
         triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or None
 
+    hk = k.shape[2]
+    if hn != hk and (hk == 0 or hn % hk):
+        raise ValueError(f"q heads ({hn}) must be a multiple of kv heads ({hk})")
+
     qt = jnp.transpose(q, (0, 2, 1, 3)) * jnp.asarray(scale, q.dtype)
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
@@ -640,8 +682,15 @@ def flash_attention(
         qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
 
     if causal and triangle_block and sq == skv and sq % min(triangle_block, sq) == 0:
+        # GQA runs natively on the band grid: kv blocks are fetched from head
+        # h // groups, so K/V are never repeated in HBM and dk/dv come back in
+        # kv-head shape
         out = _flash_band(qt, kt, vt, min(triangle_block, sq), window, interpret)
     else:
+        if hn != hk:
+            groups = hn // hk
+            kt = jnp.repeat(kt, groups, axis=1)
+            vt = jnp.repeat(vt, groups, axis=1)
         # Block defaults are env-tunable for sweeps (ACCELERATE_TPU_FLASH_BLOCK_*).
         # 1024×1024 won the round-3 sweep (docs/PERF_NOTES.md): at s<=1024 the
         # whole (b,h) attention runs in ONE grid cell, and the [block_q, block_kv]

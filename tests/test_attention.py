@@ -222,3 +222,56 @@ def test_ring_attention_rejects_sliding_window():
     ids = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         m.init(jax.random.key(0), ids)
+
+
+class TestGQA:
+    """Grouped-query attention: the band grid reads kv head h//groups directly;
+    K/V are never repeated in HBM and dk/dv come back in kv-head shape."""
+
+    def _ref(self, q, k, v, groups, window=None):
+        k_rep = jnp.repeat(k, groups, axis=2)
+        v_rep = jnp.repeat(v, groups, axis=2)
+        return dot_product_attention(q, k_rep, v_rep, causal=True, window=window)
+
+    @pytest.mark.parametrize("groups,window", [(2, None), (4, None), (2, 48)])
+    def test_band_gqa_matches_repeated_xla(self, groups, window):
+        s, hq, d = 128, 4, 32
+        q = _rand((2, s, hq, d), 30)
+        k = _rand((2, s, hq // groups, d), 31)
+        v = _rand((2, s, hq // groups, d), 32)
+        ref = self._ref(q, k, v, groups, window)
+        out = flash_attention(q, k, v, causal=True, window=window, triangle_block=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_band_gqa_gradients_match_kv_head_shape(self):
+        s, hq, groups, d = 128, 4, 2, 32
+        q = _rand((1, s, hq, d), 33)
+        k = _rand((1, s, hq // groups, d), 34)
+        v = _rand((1, s, hq // groups, d), 35)
+
+        def loss_ref(q, k, v):
+            return (self._ref(q, k, v, groups) ** 2).sum()
+
+        def loss_band(q, k, v):
+            return (flash_attention(q, k, v, causal=True, triangle_block=32) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_band = jax.grad(loss_band, argnums=(0, 1, 2))(q, k, v)
+        assert g_band[1].shape == k.shape and g_band[2].shape == v.shape
+        for a, b_ in zip(g_band, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
+
+    def test_rect_path_repeats_internally(self):
+        s, hq, groups, d = 64, 4, 2, 32
+        q = _rand((1, s, hq, d), 36)
+        k = _rand((1, s, hq // groups, d), 37)
+        v = _rand((1, s, hq // groups, d), 38)
+        ref = self._ref(q, k, v, groups)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_rejects_nondivisible_heads(self):
+        q = _rand((1, 64, 4, 32), 39)
+        k = _rand((1, 64, 3, 32), 40)
+        with pytest.raises(ValueError, match="multiple of kv heads"):
+            flash_attention(q, k, k, causal=True, triangle_block=32)
