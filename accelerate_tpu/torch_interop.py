@@ -82,16 +82,20 @@ def _layer_norm(mod, params, x):
     return y
 
 
-def _conv2d(mod, params, x):
-    # torch NCHW / OIHW
-    dn = jax.lax.conv_dimension_numbers(x.shape, params["weight"].shape, ("NCHW", "OIHW", "NCHW"))
+def _convnd(mod, params, x):
+    """Conv1d/Conv2d: torch NC<spatial> / OI<spatial> layouts, any rank."""
+    spatial = "HW"[: x.ndim - 2] if x.ndim <= 4 else "HWD"[: x.ndim - 2]
+    spec = "NC" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, params["weight"].shape, (spec, "OI" + spatial, spec)
+    )
     pad = mod.padding if isinstance(mod.padding, str) else [(p, p) for p in mod.padding]
     y = jax.lax.conv_general_dilated(
         x, params["weight"], window_strides=mod.stride, padding=pad,
         rhs_dilation=mod.dilation, dimension_numbers=dn, feature_group_count=mod.groups,
     )
     if params.get("bias") is not None:
-        y = y + params["bias"][None, :, None, None]
+        y = y + params["bias"].reshape((1, -1) + (1,) * (x.ndim - 2))
     return y
 
 
@@ -183,11 +187,65 @@ def _mha(mod, params, q, k, v, **kwargs):
     raise UnsupportedTorchOp("nn.MultiheadAttention: use explicit q/k/v layers")
 
 
+def _conv_transpose2d(mod, params, x):
+    if any(getattr(mod, "output_padding", (0, 0))):
+        raise UnsupportedTorchOp("ConvTranspose2d with output_padding")
+    if getattr(mod, "groups", 1) != 1:
+        raise UnsupportedTorchOp("ConvTranspose2d with groups > 1")
+    if any(d != 1 for d in getattr(mod, "dilation", (1, 1))):
+        raise UnsupportedTorchOp("ConvTranspose2d with dilation")
+    # torch weight layout is (in, out/groups, kh, kw) = "IOHW"
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, tuple(params["weight"].shape[i] for i in (1, 0, 2, 3)), ("NCHW", "OIHW", "NCHW")
+    )
+    pad = [(p, p) for p in mod.padding]
+    y = jax.lax.conv_transpose(
+        x, params["weight"], strides=mod.stride, padding=pad,
+        dimension_numbers=dn, transpose_kernel=True,
+    )
+    if params.get("bias") is not None:
+        y = y + params["bias"][None, :, None, None]
+    return y
+
+
+def _lerp_axis(x, out_len, axis):
+    """1-D linear resample along ``axis`` with align_corners=True index mapping
+    (output i samples input i*(in-1)/(out-1))."""
+    in_len = x.shape[axis]
+    if out_len == 1 or in_len == 1:
+        idx = jnp.zeros((out_len,), jnp.float32)
+    else:
+        idx = jnp.linspace(0.0, in_len - 1.0, out_len)
+    lo = jnp.floor(idx).astype(jnp.int32)
+    hi = jnp.clip(lo + 1, 0, in_len - 1)
+    w = (idx - lo).reshape([out_len if a == axis else 1 for a in range(x.ndim)])
+    return jnp.take(x, lo, axis=axis) * (1 - w) + jnp.take(x, hi, axis=axis) * w
+
+
+def _upsample(mod, params, x):
+    mode = getattr(mod, "mode", "nearest")
+    if mod.size is not None:
+        size = mod.size if isinstance(mod.size, (tuple, list)) else (mod.size,) * (x.ndim - 2)
+    else:
+        sf = mod.scale_factor
+        sf = sf if isinstance(sf, (tuple, list)) else (sf,) * (x.ndim - 2)
+        size = tuple(int(d * f) for d, f in zip(x.shape[2:], sf))
+    if mode in ("bilinear", "linear") and getattr(mod, "align_corners", None):
+        y = x
+        for i, s in enumerate(size):
+            y = _lerp_axis(y, s, 2 + i)
+        return y
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "bilinear"}.get(mode)
+    if method is None or getattr(mod, "align_corners", None):
+        raise UnsupportedTorchOp(f"Upsample(mode={mode!r}, align_corners=True)")
+    return jax.image.resize(x, (*x.shape[:2], *size), method=method)
+
+
 MODULE_TABLE: dict[str, Callable] = {
     "Linear": _linear,
     "Embedding": _embedding,
     "LayerNorm": _layer_norm,
-    "Conv2d": _conv2d,
+    "Conv2d": _convnd,
     "GroupNorm": _group_norm,
     "BatchNorm1d": _batch_norm,
     "BatchNorm2d": _batch_norm,
@@ -204,6 +262,19 @@ MODULE_TABLE: dict[str, Callable] = {
     "Softmax": lambda m, p, x: jax.nn.softmax(x, axis=m.dim if m.dim is not None else -1),
     "Flatten": lambda m, p, x: x.reshape(*x.shape[: m.start_dim], -1),
     "MultiheadAttention": _mha,
+    "Conv1d": _convnd,
+    "ConvTranspose2d": _conv_transpose2d,
+    "Upsample": _upsample,
+    "UpsamplingNearest2d": _upsample,
+    "UpsamplingBilinear2d": _upsample,
+    "LeakyReLU": lambda m, p, x: jax.nn.leaky_relu(x, m.negative_slope),
+    "ELU": lambda m, p, x: jax.nn.elu(x, m.alpha),
+    "ReLU6": lambda m, p, x: jnp.clip(x, 0, 6),
+    "Hardtanh": lambda m, p, x: jnp.clip(x, m.min_val, m.max_val),
+    "Hardswish": lambda m, p, x: jax.nn.hard_swish(x),
+    "Mish": lambda m, p, x: x * jnp.tanh(jax.nn.softplus(x)),
+    "Softplus": lambda m, p, x: jax.nn.softplus(m.beta * x) / m.beta,
+    "LogSoftmax": lambda m, p, x: jax.nn.log_softmax(x, axis=m.dim if m.dim is not None else -1),
 }
 
 
@@ -216,12 +287,53 @@ def _fn_gelu(x, approximate="none"):
     return jax.nn.gelu(x, approximate=approximate != "none")
 
 
+def _fn_split(x, split_size_or_sections, dim=0):
+    """torch.split: int chunk size OR explicit per-section sizes."""
+    if isinstance(split_size_or_sections, (list, tuple)):
+        bounds, acc = [], 0
+        for s in split_size_or_sections[:-1]:
+            acc += s
+            bounds.append(acc)
+        return tuple(jnp.split(x, bounds, axis=dim))
+    size = split_size_or_sections
+    return tuple(jnp.split(x, list(range(size, x.shape[dim], size)), axis=dim))
+
+
+def _fn_chunk(x, chunks, dim=0):
+    """torch.chunk: ceil-sized chunks (may return FEWER than requested) —
+    array_split's even distribution differs."""
+    length = x.shape[dim]
+    size = -(-length // chunks)
+    return tuple(jnp.split(x, list(range(size, length, size)), axis=dim))
+
+
+def _fn_var_std(fn):
+    """torch.var/std: legacy (input, dim, unbiased, keepdim) AND new
+    (input, dim, *, correction, keepdim) signatures."""
+
+    def wrapped(x, dim=None, unbiased=None, keepdim=False, correction=None, **kw):
+        if correction is None:
+            correction = 1 if unbiased is None else int(bool(unbiased))
+        return fn(x, axis=dim, keepdims=keepdim, ddof=correction)
+
+    return wrapped
+
+
 def _build_function_table():
     import torch
     import torch.nn.functional as F
 
     return {
         torch.add: jnp.add, operator.add: operator.add,
+        operator.gt: operator.gt, operator.lt: operator.lt,
+        operator.ge: operator.ge, operator.le: operator.le,
+        operator.eq: operator.eq, operator.ne: operator.ne,
+        operator.neg: operator.neg, operator.mod: operator.mod,
+        torch.gt: jnp.greater, torch.lt: jnp.less,
+        torch.ge: jnp.greater_equal, torch.le: jnp.less_equal,
+        torch.eq: jnp.equal, torch.ne: jnp.not_equal,
+        torch.logical_and: jnp.logical_and, torch.logical_or: jnp.logical_or,
+        torch.logical_not: jnp.logical_not,
         torch.sub: jnp.subtract, operator.sub: operator.sub,
         torch.mul: jnp.multiply, operator.mul: operator.mul,
         torch.div: jnp.divide, operator.truediv: operator.truediv,
@@ -232,8 +344,11 @@ def _build_function_table():
         torch.exp: jnp.exp, torch.log: jnp.log, torch.sqrt: jnp.sqrt,
         torch.rsqrt: jax.lax.rsqrt,
         torch.tanh: jnp.tanh, torch.sigmoid: jax.nn.sigmoid,
-        torch.relu: jax.nn.relu, F.relu: jax.nn.relu,
-        F.gelu: _fn_gelu, F.silu: jax.nn.silu, F.sigmoid: jax.nn.sigmoid,
+        torch.relu: lambda x, **k: jax.nn.relu(x),
+        F.relu: lambda x, inplace=False, **k: jax.nn.relu(x),
+        F.gelu: _fn_gelu,
+        F.silu: lambda x, inplace=False, **k: jax.nn.silu(x),
+        F.sigmoid: jax.nn.sigmoid,
         F.softmax: _fn_softmax, torch.softmax: _fn_softmax,
         F.dropout: lambda x, *a, **k: x,
         torch.cat: lambda tensors, dim=0: jnp.concatenate(tensors, axis=dim),
@@ -257,6 +372,26 @@ def _build_function_table():
         F.embedding: lambda idx, w, *a, **k: w[idx],
         F.layer_norm: _fn_layer_norm,
         F.scaled_dot_product_attention: _fn_sdpa,
+        F.cross_entropy: _fn_cross_entropy,
+        F.nll_loss: _fn_nll_loss,
+        F.mse_loss: _fn_mse_loss,
+        F.binary_cross_entropy_with_logits: _fn_bce_with_logits,
+        F.log_softmax: _fn_log_softmax, torch.log_softmax: _fn_log_softmax,
+        F.leaky_relu: lambda x, negative_slope=0.01, **k: jax.nn.leaky_relu(x, negative_slope),
+        F.elu: lambda x, alpha=1.0, **k: jax.nn.elu(x, alpha),
+        F.relu6: lambda x, **k: jnp.clip(x, 0, 6),
+        F.hardtanh: lambda x, min_val=-1.0, max_val=1.0, **k: jnp.clip(x, min_val, max_val),
+        F.softplus: lambda x, beta=1.0, **k: jax.nn.softplus(beta * x) / beta,
+        F.mish: lambda x, **k: x * jnp.tanh(jax.nn.softplus(x)),
+        F.hardswish: lambda x, **k: jax.nn.hard_swish(x),
+        F.pad: _fn_pad,
+        torch.clamp: lambda x, min=None, max=None, **k: jnp.clip(x, min, max),
+        torch.abs: jnp.abs,
+        torch.erf: jax.scipy.special.erf,
+        torch.split: _fn_split,
+        torch.chunk: _fn_chunk,
+        torch.var: _fn_var_std(jnp.var),
+        torch.std: _fn_var_std(jnp.std),
         getattr: getattr,
     }
 
@@ -286,6 +421,98 @@ def _getitem(obj, idx):
     else:
         idx = fix(idx)
     return obj[idx]
+
+
+def _fn_log_softmax(x, dim=-1, **kw):
+    return jax.nn.log_softmax(x, axis=dim)
+
+
+def _apply_reduction(per_elem, reduction):
+    if reduction == "mean":
+        return per_elem.mean()
+    if reduction == "sum":
+        return per_elem.sum()
+    if reduction == "none":
+        return per_elem
+    raise UnsupportedTorchOp(f"reduction={reduction!r}")
+
+
+def _flatten_class_dim(input, target):
+    """[N, C, d1...] logits + [N, d1...] targets -> [N*d1..., C] / [N*d1...]."""
+    if input.ndim > 2:
+        c = input.shape[1]
+        input = jnp.moveaxis(input, 1, -1).reshape(-1, c)
+        target = target.reshape(-1)
+    return input, target
+
+
+def _weighted_nll(logp, target, weight, ignore_index, reduction, label_smoothing=0.0):
+    """Shared core of F.cross_entropy / F.nll_loss over log-probabilities,
+    matching torch exactly: per-sample loss
+    (1-ls) * (-w[y] logp[y]) + ls * (-sum_c w_c logp_c / C), mean reduction
+    divides by sum of w[y] over valid rows."""
+    valid = target != ignore_index
+    safe = jnp.where(valid, target, 0)
+    picked = -jnp.take_along_axis(logp, safe[:, None], axis=-1)[:, 0]
+    n_classes = logp.shape[-1]
+    if weight is not None:
+        wy = weight[safe]
+        picked = picked * wy
+        smooth = -(logp * weight[None, :]).sum(axis=-1) / n_classes
+        denom = (wy * valid).sum()
+    else:
+        smooth = -logp.sum(axis=-1) / n_classes
+        denom = valid.sum()
+    per = (1.0 - label_smoothing) * picked + label_smoothing * smooth
+    per = jnp.where(valid, per, 0.0)
+    if reduction == "mean":
+        return per.sum() / jnp.maximum(denom, 1e-9)
+    return _apply_reduction(per, reduction)
+
+
+def _fn_cross_entropy(input, target, weight=None, ignore_index=-100,
+                      reduction="mean", label_smoothing=0.0, **kw):
+    """torch.nn.functional.cross_entropy for int class targets ([N, C, ...]
+    logits vs [N, ...] indices), incl. ignore_index, per-class weight, and
+    label smoothing (torch's exact weighted-smoothing formula)."""
+    if target.dtype not in (jnp.int32, jnp.int64):
+        raise UnsupportedTorchOp("F.cross_entropy with probability targets")
+    input, target = _flatten_class_dim(input, target)
+    logp = jax.nn.log_softmax(input, axis=-1)
+    return _weighted_nll(logp, target, weight, ignore_index, reduction, label_smoothing)
+
+
+def _fn_nll_loss(input, target, weight=None, ignore_index=-100, reduction="mean", **kw):
+    """F.nll_loss over log-probabilities — cross_entropy minus the log_softmax;
+    spatial [N, C, d...] inputs flatten like cross_entropy."""
+    input, target = _flatten_class_dim(input, target)
+    return _weighted_nll(input, target, weight, ignore_index, reduction)
+
+
+def _fn_mse_loss(input, target, reduction="mean", **kw):
+    return _apply_reduction((input - target) ** 2, reduction)
+
+
+def _fn_bce_with_logits(input, target, weight=None, pos_weight=None, reduction="mean", **kw):
+    logp = jax.nn.log_sigmoid(input)
+    lognotp = jax.nn.log_sigmoid(-input)
+    if pos_weight is not None:
+        per = -(pos_weight * target * logp + (1.0 - target) * lognotp)
+    else:
+        per = -(target * logp + (1.0 - target) * lognotp)
+    if weight is not None:
+        per = per * weight
+    return _apply_reduction(per, reduction)
+
+
+def _fn_pad(x, pad, mode="constant", value=0.0):
+    """torch F.pad: flat (before, after) pairs starting from the LAST dim."""
+    if mode != "constant":
+        raise UnsupportedTorchOp(f"F.pad(mode={mode!r})")
+    pairs = [(0, 0)] * x.ndim
+    for i in range(len(pad) // 2):
+        pairs[x.ndim - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    return jnp.pad(x, pairs, constant_values=value)
 
 
 def _fn_layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
@@ -337,8 +564,8 @@ METHOD_TABLE: dict[str, Callable] = {
     "to": lambda x, *a, **k: x,
     "float": lambda x: x.astype(jnp.float32),
     "type_as": lambda x, other: x.astype(other.dtype),
-    "split": lambda x, size, dim=0: tuple(jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
-    "chunk": lambda x, n, dim=0: tuple(jnp.array_split(x, n, axis=dim)),
+    "split": _fn_split,
+    "chunk": _fn_chunk,
     "pow": jnp.power,
     "clamp": lambda x, min=None, max=None: jnp.clip(x, min, max),
     "repeat": lambda x, *reps: jnp.tile(x, reps),
@@ -373,10 +600,19 @@ def convert_torch_module(
     re-convert with ``train=False`` for serving).
     """
     import torch
+    import torch.nn.functional as F
 
     module = module.train() if train else module.eval()
+    # Loss functionals contain tensor-dependent python checks (e.g. mse_loss's
+    # size-mismatch warning) that fx cannot trace through; keep them as leaf
+    # call_function nodes — the function table maps them whole.
+    autowrap = (
+        F.mse_loss, F.cross_entropy, F.nll_loss, F.binary_cross_entropy_with_logits,
+    )
     try:
-        gm = torch.fx.symbolic_trace(module)
+        tracer = torch.fx.Tracer(autowrap_functions=autowrap)
+        graph = tracer.trace(module)
+        gm = torch.fx.GraphModule(tracer.root, graph)
     except Exception:
         from transformers.utils import fx as hf_fx  # HF models need their tracer
 
