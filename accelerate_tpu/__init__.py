@@ -52,8 +52,24 @@ from .utils.quantization import (
     quantize_model,
     quantize_params,
 )
-from .parallel.compression import CommHookConfig
+from .parallel.compression import CommHookConfig, DDPCommunicationHookType
+from .big_modeling import (
+    BlockwiseModel,
+    cpu_offload,
+    cpu_offload_with_hook,
+    disk_offload,
+    dispatch_model,
+    infer_auto_device_map,
+    init_empty_weights,
+    init_on_device,
+    load_checkpoint_and_dispatch,
+)
+from .utils.imports import is_rich_available
+
+if is_rich_available():  # optional extra: keep base import rich-free
+    from .utils import rich
 from .utils.dataclasses import (
+    AutocastKwargs,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
     DistributedDataParallelKwargs,

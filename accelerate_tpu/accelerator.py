@@ -103,7 +103,7 @@ class PreparedModel:
         self.module = module  # the original user object, for unwrap_model
         self.extra_state = extra_state  # mutable non-param collections (replicated)
         self._acc_grads = None  # used only when no optimizer is prepared
-        self._jit_forward: Callable | None = None
+        self._jit_forwards: dict[bool, Callable] = {}
         self._hook = None  # hooks.ModelHook attachment point
         self.training = True
 
@@ -165,23 +165,26 @@ class PreparedModel:
         )
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
-        if self._jit_forward is None:
+        from .utils.precision import autocast_enabled
+
+        cast = autocast_enabled()  # False inside autocast(AutocastKwargs(enabled=False))
+        if cast not in self._jit_forwards:
             policy = self.policy
             has_state = self.extra_state is not None
 
-            def fwd(params, state, args, kwargs):
-                p = policy.cast_to_compute(params)
+            def fwd(params, state, args, kwargs, _cast=cast):
+                p = policy.cast_to_compute(params) if _cast else params
                 if has_state:
                     out, new_state = self.apply_fn(p, *args, extra_state=state, **kwargs)
                 else:
                     out, new_state = self.apply_fn(p, *args, **kwargs), None
-                return policy.cast_to_output(out), new_state
+                return (policy.cast_to_output(out) if _cast else out), new_state
 
-            self._jit_forward = jax.jit(fwd)
+            self._jit_forwards[cast] = jax.jit(fwd)
         params = self.params
         if self._hook is not None:
             params, args, kwargs = self._hook.pre_forward(self, params, args, kwargs)
-        out, new_state = self._jit_forward(params, self.extra_state, args, kwargs)
+        out, new_state = self._jit_forwards[cast](params, self.extra_state, args, kwargs)
         if new_state is not None and self.training:
             # eval() forwards must be side-effect free: discard state mutations
             # (fp8 amax rolls, batch_stats updates) outside training mode
@@ -349,6 +352,7 @@ class Accelerator:
         engines collapsed onto mesh axes. Env activation mirrors the reference's
         ``ACCELERATE_USE_DEEPSPEED``/``_FSDP``/``_MEGATRON_LM`` switches."""
         from .utils.dataclasses import (
+            AutocastKwargs,
             DataLoaderConfiguration,
             DeepSpeedPlugin,
             DistributedDataParallelKwargs,
@@ -382,6 +386,7 @@ class Accelerator:
         self.profile_handler = None
         self.fp8_recipe_handler = None
         self.init_handler = None
+        self.autocast_handler = None
         scaler_kwargs = None
         seen: set[type] = set()
         for handler in kwargs_handlers or []:
@@ -398,6 +403,8 @@ class Accelerator:
                 self.fp8_recipe_handler = handler
             elif isinstance(handler, InitProcessGroupKwargs):
                 self.init_handler = handler
+            elif isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
             elif isinstance(handler, DataLoaderConfiguration):
                 raise ValueError("Pass DataLoaderConfiguration as dataloader_config=, not a handler.")
             else:
@@ -1140,10 +1147,21 @@ class Accelerator:
     # -------------------------------------------------------------- contexts
     @contextlib.contextmanager
     def autocast(self, autocast_handler: Any = None):
-        """API parity (reference `accelerator.py:3422`): precision is a functional
-        cast policy applied inside prepared forwards, so there is nothing to
-        enable here; the context exists so reference code runs unchanged."""
-        yield
+        """Reference `accelerator.py:3422`. Precision is a functional cast
+        policy applied inside prepared forwards, so *enabling* is the ambient
+        state; the context's real lever is ``AutocastKwargs(enabled=False)``,
+        which makes eager `PreparedModel` calls inside the block skip the
+        compute-dtype cast (numerically sensitive regions run in the fp32
+        master dtype)."""
+        from .utils.precision import reset_autocast_enabled, set_autocast_enabled
+
+        handler = autocast_handler or self.autocast_handler
+        enabled = handler.enabled if handler is not None else True
+        token = set_autocast_enabled(enabled)
+        try:
+            yield
+        finally:
+            reset_autocast_enabled(token)
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Any = None, log_dir: str | None = None):

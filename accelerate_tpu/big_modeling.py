@@ -221,6 +221,18 @@ class BlockwiseModel:
     device_map: dict[str, str] = field(default_factory=dict)
     offload_loader: OffloadedWeightsLoader | None = None
     sharding: Any = None  # NamedSharding for resident/streamed placement
+    # cpu_offload_with_hook mode: streamed blocks STAY on device across calls
+    # until the user hook's offload() evicts them (multi-model pipelines)
+    cache_resident: bool = False
+    _cache: dict = field(default_factory=dict, repr=False)
+    _prev_hook: Any = None
+
+    def _evict_cache(self) -> None:
+        for _params, transient in self._cache.values():
+            for p in transient:
+                if not p.is_deleted():
+                    p.delete()
+        self._cache.clear()
 
     def _place_host(self, host: Any) -> Any:
         return jax.tree.map(
@@ -267,15 +279,25 @@ class BlockwiseModel:
             raise KeyError(f"no device_map entry covers block {name!r}")
         return sub, transient
 
+    def _block_params_cached(self, name: str) -> tuple[Any, list]:
+        if name not in self._cache:
+            self._cache[name] = self._block_params(name)
+        return self._cache[name][0], []  # nothing transient: eviction is manual
+
     def __call__(self, x: Any) -> Any:
+        if self._prev_hook is not None:
+            # multi-model pipeline: entering this model evicts the previous
+            # one's device-resident weights (reference cpu_offload_with_hook)
+            self._prev_hook.offload()
+        fetch = self._block_params_cached if self.cache_resident else self._block_params
         names = [n for n, _ in self.block_fns]
         fns = dict(self.block_fns)
         # prefetch pipeline: launch block i+1's H2D before computing block i
-        next_params, next_transient = self._block_params(names[0])
+        next_params, next_transient = fetch(names[0])
         for i, name in enumerate(names):
             cur, cur_transient = next_params, next_transient
             if i + 1 < len(names):
-                next_params, next_transient = self._block_params(names[i + 1])
+                next_params, next_transient = fetch(names[i + 1])
             x = fns[name](cur, x)
             for p in cur_transient:  # free streamed HBM, keep resident parts
                 if not p.is_deleted():
@@ -350,6 +372,40 @@ def cpu_offload(model: BlockwiseModel, state_dict: Any) -> BlockwiseModel:
     """Everything on host, streamed per block (reference `big_modeling.py:170`)."""
     device_map = {name: "cpu" for name, _ in model.block_fns}
     return dispatch_model(model, device_map, state_dict)
+
+
+class UserCpuOffloadHook:
+    """Manual offload control returned by `cpu_offload_with_hook` (reference
+    `big_modeling.py:259` / `hooks.py` UserCpuOffloadHook): ``offload()`` frees
+    this model's device-resident streamed weights; ``remove()`` also turns the
+    stay-resident behavior off."""
+
+    def __init__(self, model: BlockwiseModel):
+        self.model = model
+
+    def offload(self) -> None:
+        self.model._evict_cache()
+
+    def remove(self) -> None:
+        self.model.cache_resident = False
+        self.model._prev_hook = None
+        self.model._evict_cache()
+
+
+def cpu_offload_with_hook(
+    model: BlockwiseModel,
+    state_dict: Any = None,
+    prev_module_hook: "UserCpuOffloadHook | None" = None,
+) -> tuple[BlockwiseModel, UserCpuOffloadHook]:
+    """CPU-offload ``model`` but keep its weights on device across calls until
+    the returned hook's ``offload()`` — the multi-model-pipeline pattern
+    (reference `big_modeling.py:259`): pass the previous model's hook as
+    ``prev_module_hook`` and invoking this model evicts that one first."""
+    if state_dict is not None:
+        model = cpu_offload(model, state_dict)
+    model.cache_resident = True
+    model._prev_hook = prev_module_hook
+    return model, UserCpuOffloadHook(model)
 
 
 def disk_offload(model: BlockwiseModel, state_dict: Any, offload_dir: str) -> BlockwiseModel:

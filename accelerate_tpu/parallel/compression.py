@@ -30,14 +30,26 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from enum import Enum
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-# Mirrors reference `DDPCommHookType` (`utils/dataclasses.py:80-115`)
+# Mirrors reference `DDPCommunicationHookType` (`utils/dataclasses.py:80-115`)
 COMM_HOOK_TYPES = ("no", "fp16", "bf16", "power_sgd", "batched_power_sgd")
+
+
+class DDPCommunicationHookType(str, Enum):
+    """Reference enum; values interchange with the plain hook-name strings
+    accepted everywhere a hook is configured."""
+
+    NO = "no"
+    FP16 = "fp16"
+    BF16 = "bf16"
+    POWER_SGD = "power_sgd"
+    BATCHED_POWER_SGD = "batched_power_sgd"
 
 
 @dataclass
@@ -57,6 +69,8 @@ class CommHookConfig:
     min_compression_elems: int = 1024  # tensors smaller than this go uncompressed
 
     def __post_init__(self):
+        if isinstance(self.comm_hook, DDPCommunicationHookType):
+            self.comm_hook = self.comm_hook.value
         if self.comm_hook not in COMM_HOOK_TYPES:
             raise ValueError(f"comm_hook must be one of {COMM_HOOK_TYPES}, got {self.comm_hook!r}")
 

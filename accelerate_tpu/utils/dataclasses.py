@@ -195,6 +195,21 @@ class MegatronLMPlugin(KwargsHandler):
 
 
 @dataclass
+class AutocastKwargs(KwargsHandler):
+    """Customize `Accelerator.autocast` (reference `utils/dataclasses.py`
+    AutocastKwargs). Under jit, mixed precision is a functional cast applied
+    inside prepared forwards, so the ONE meaningful lever is ``enabled=False``:
+    eager `PreparedModel` calls inside the context skip the compute-dtype cast
+    and run in the master (fp32) dtype — the reference's
+    "disable autocast for a numerically sensitive region" use case.
+    ``cache_enabled`` is accepted for API compatibility (torch's autocast
+    weight-cast cache has no JAX analogue — XLA caches compiled programs)."""
+
+    enabled: bool = True
+    cache_enabled: bool | None = None
+
+
+@dataclass
 class InitProcessGroupKwargs(KwargsHandler):
     """Distributed-init knobs (reference `InitProcessGroupKwargs`): mapped to
     jax.distributed.initialize timeouts."""
@@ -222,6 +237,8 @@ class DistributedDataParallelKwargs(KwargsHandler):
     def to_comm_hook_config(self):
         from ..parallel.compression import CommHookConfig
 
+        # DDPCommunicationHookType is a str Enum: "no" comparison and the
+        # CommHookConfig ctor (which normalizes in __post_init__) handle it
         if self.comm_hook == "no":
             return None
         return CommHookConfig(

@@ -29,6 +29,28 @@ def _cast_floating(tree: Any, dtype) -> Any:
     return jax.tree.map(_cast, tree)
 
 
+# ---- autocast context: consulted by PreparedModel at call time -------------
+# contextvar (not a module global) so nested/async usage stays correct
+import contextvars
+
+_AUTOCAST_ENABLED = contextvars.ContextVar("accelerate_tpu_autocast_enabled", default=True)
+
+
+def autocast_enabled() -> bool:
+    """Whether prepared forwards should apply the compute-dtype cast
+    (False inside `Accelerator.autocast(AutocastKwargs(enabled=False))`)."""
+    return _AUTOCAST_ENABLED.get()
+
+
+def set_autocast_enabled(enabled: bool):
+    """Returns a reset token for the enclosing context manager."""
+    return _AUTOCAST_ENABLED.set(bool(enabled))
+
+
+def reset_autocast_enabled(token) -> None:
+    _AUTOCAST_ENABLED.reset(token)
+
+
 @dataclass(frozen=True)
 class PrecisionPolicy:
     """What dtype each tensor class lives in. ``param_dtype`` is the master copy;

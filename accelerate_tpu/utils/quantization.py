@@ -275,7 +275,7 @@ def quantize_model(model: Any, config: QuantizationConfig):
             return inner(dequantize_params(p), *args, **kwargs)
 
         model.apply_fn = q_apply
-        model._jit_forward = None  # drop any forward compiled against dense params
+        model._jit_forwards = {}  # drop any forward compiled against dense params
         return model
 
     raise TypeError(f"Cannot quantize object of type {type(model)}")

@@ -404,3 +404,53 @@ class TestFusedFp16:
         got = _train(acc, batches, lr=0.05, use_fused=True)
         ref = _train_reference(batches, lr=0.05)
         np.testing.assert_allclose(got["a"], ref["a"], atol=2e-2)
+
+
+class TestAutocastContext:
+    def test_autocast_disabled_skips_compute_cast(self):
+        """AutocastKwargs(enabled=False) makes eager PreparedModel calls run in
+        the fp32 master dtype (the reference's sensitive-region use case)."""
+        import accelerate_tpu as at
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc = at.Accelerator(mixed_precision="bf16")
+        seen = []
+
+        def apply_fn(p, x):
+            seen.append(p["w"].dtype)
+            return x @ p["w"]
+
+        model = acc.prepare((apply_fn, {"w": np.eye(4, dtype=np.float32)}))
+        x = jnp.ones((2, 4))
+        out_amp = model(x)
+        assert seen[-1] == jnp.bfloat16
+        with acc.autocast(at.AutocastKwargs(enabled=False)):
+            out_fp32 = model(x)
+        assert seen[-1] == jnp.float32
+        assert out_fp32.dtype == jnp.float32
+        # handler from kwargs_handlers is the default for a bare autocast()
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        acc2 = at.Accelerator(
+            mixed_precision="bf16", kwargs_handlers=[at.AutocastKwargs(enabled=False)]
+        )
+        seen.clear()
+        model2 = acc2.prepare((apply_fn, {"w": np.eye(4, dtype=np.float32)}))
+        with acc2.autocast():
+            model2(x)
+        assert seen[-1] == jnp.float32
+
+    def test_ddp_comm_hook_enum_interchanges_with_strings(self):
+        import accelerate_tpu as at
+        from accelerate_tpu.parallel.compression import CommHookConfig
+
+        cfg = CommHookConfig(comm_hook=at.DDPCommunicationHookType.BF16)
+        assert cfg.comm_hook == "bf16"
+        kw = at.DistributedDataParallelKwargs(
+            comm_hook=at.DDPCommunicationHookType.POWER_SGD
+        )
+        assert kw.to_comm_hook_config().comm_hook == "power_sgd"
+        assert at.DistributedDataParallelKwargs(
+            comm_hook=at.DDPCommunicationHookType.NO
+        ).to_comm_hook_config() is None

@@ -277,3 +277,28 @@ def test_hooks_on_prepared_model():
     assert calls == ["pre", "post"]
     remove_hook_from_module(model)
     np.testing.assert_allclose(np.asarray(model(jnp.asarray([3.0]))), [6.0])
+
+
+def test_cpu_offload_with_hook_pipeline(tiny_gpt2):
+    """Multi-model pipeline: weights stay device-resident across calls until
+    the hook offloads; entering the next model evicts the previous one
+    (reference big_modeling.py:259)."""
+    from accelerate_tpu.big_modeling import cpu_offload_with_hook
+
+    cfg, module, params, ids, ref = tiny_gpt2
+    sd = gpt2_blockwise_state_dict(params)
+    m1, hook1 = cpu_offload_with_hook(gpt2_blockwise(cfg), sd)
+    m2, hook2 = cpu_offload_with_hook(gpt2_blockwise(cfg), sd, prev_module_hook=hook1)
+
+    out = m1(ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    assert m1._cache, "weights should stay resident after the call"
+    cached = next(iter(m1._cache.values()))
+
+    out2 = m2(ids)  # entering m2 must evict m1
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    assert not m1._cache
+    # second m1 call re-stages and still agrees
+    np.testing.assert_allclose(np.asarray(m1(ids)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    hook1.remove()
+    assert not m1.cache_resident and not m1._cache
