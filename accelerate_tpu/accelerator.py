@@ -232,6 +232,20 @@ class GradientAccumulationPlugin:
     sync_with_dataloader: bool = True
 
 
+class _RemovableHandle:
+    """Deregistration handle for state pre-hooks (torch RemovableHandle role)."""
+
+    _next_id = 0
+
+    def __init__(self, registry: dict):
+        self._registry = registry
+        self.id = _RemovableHandle._next_id
+        _RemovableHandle._next_id += 1
+
+    def remove(self) -> None:
+        self._registry.pop(self.id, None)
+
+
 class Accelerator:
     def __init__(
         self,
@@ -277,6 +291,7 @@ class Accelerator:
             kwargs_handlers,
         )
         self._use_seedable_sampler = True
+        self._use_stateful_dataloader = True
         if dataloader_config is not None:
             if split_batches or not even_batches or dispatch_batches is not None:
                 raise ValueError(
@@ -287,6 +302,7 @@ class Accelerator:
             even_batches = dataloader_config.even_batches
             dispatch_batches = dataloader_config.dispatch_batches
             self._use_seedable_sampler = dataloader_config.use_seedable_sampler
+            self._use_stateful_dataloader = dataloader_config.use_stateful_dataloader
         if parallelism_config is None:
             # launcher env contract (commands/launch.py): dp,fsdp,stage,seq,tp
             env_par = os.environ.get("ACCELERATE_TPU_PARALLELISM")
@@ -327,6 +343,8 @@ class Accelerator:
         self.step = 0
         self.flag_tensor = None
         self._models: list[PreparedModel] = []
+        self._save_state_pre_hooks: dict[int, Callable] = {}
+        self._load_state_pre_hooks: dict[int, Callable] = {}
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
@@ -520,6 +538,23 @@ class Accelerator:
     def on_process(self, function: Callable | None = None, process_index: int = 0) -> Callable:
         return self.partial_state.on_process(function, process_index)
 
+    def on_local_process(
+        self, function: Callable | None = None, local_process_index: int = 0
+    ) -> Callable:
+        """Run only on processes with this LOCAL index (reference
+        `accelerator.py` on_local_process). One process owns each host here, so
+        every process has local index 0: index 0 runs everywhere (each host's
+        sole process), other indices nowhere."""
+        if function is None:
+            return functools.partial(self.on_local_process, local_process_index=local_process_index)
+
+        @functools.wraps(function)
+        def wrapper(*args: Any, **kwargs: Any):
+            if self.partial_state.local_process_index == local_process_index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
     def print(self, *args: Any, **kwargs: Any) -> None:
         self.partial_state.print(*args, **kwargs)
 
@@ -550,6 +585,13 @@ class Accelerator:
         """
         result: list[Any] = [None] * len(args)
         model_indices: list[int] = []
+        for obj in args:
+            if self.verify_device_map(obj):
+                raise ValueError(
+                    "You can't train a model that has been loaded with a "
+                    "multi-entry device map (big-model inference dispatch); "
+                    "prepare the underlying params on a mesh instead."
+                )
         # pass 1: models and dataloaders
         for i, obj in enumerate(args):
             if isinstance(obj, PreparedModel):
@@ -1145,6 +1187,87 @@ class Accelerator:
         return False
 
     # -------------------------------------------------------------- contexts
+    def save(self, obj: Any, f: str, safe_serialization: bool = False) -> None:
+        """Rank-gated serialization of any object (reference `Accelerator.save`
+        -> `utils/other.py:save`): array pytrees go to safetensors when
+        ``safe_serialization`` (interchange format), anything else to pickle
+        with array leaves converted to host numpy. Main process writes; other
+        ranks no-op."""
+        if not self.is_main_process:
+            return
+        import pickle
+
+        host = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, obj
+        )
+        if safe_serialization:
+            from safetensors.numpy import save_file
+
+            from .utils.safetensors_io import flatten_state_dict
+
+            save_file(flatten_state_dict(host), f)
+            return
+        with open(f, "wb") as fh:
+            pickle.dump(host, fh)
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:
+        """True when any prepared optimizer skipped its last step (fp16
+        overflow) — reference `Accelerator.optimizer_step_was_skipped`."""
+        return any(bool(opt.step_was_skipped) for opt in self._optimizers)
+
+    @property
+    def use_seedable_sampler(self) -> bool:
+        return self._use_seedable_sampler
+
+    @property
+    def non_blocking(self) -> bool:
+        """Device transfers are asynchronous by nature in JAX (reference flag
+        parity: always True)."""
+        return True
+
+    @property
+    def use_stateful_dataloader(self) -> bool:
+        """Echoes ``DataLoaderConfiguration.use_stateful_dataloader``. Prepared
+        loaders here support state_dict/load_state_dict regardless (no
+        torchdata dependency); the flag records the user's intent for
+        reference-code compatibility."""
+        return self._use_stateful_dataloader
+
+    @property
+    def save_iteration(self) -> int:
+        """Next automatic checkpoint index (reference `save_iteration`)."""
+        return self.project_configuration.iteration
+
+    @property
+    def fp8_backend(self) -> str | None:
+        """'NATIVE' when fp8 training is configured (XLA-native delayed-scaling
+        path, `ops/fp8.py`) — the reference reports TE/MSAMP here."""
+        if self.mixed_precision == "fp8" or self.fp8_recipe_handler is not None:
+            return "NATIVE"
+        return None
+
+    def verify_device_map(self, model: Any) -> bool:
+        """True when ``model`` carries a multi-entry big-model device map;
+        `prepare` calls this and refuses such models (reference
+        `accelerator.py` verify_device_map role)."""
+        device_map = getattr(model, "device_map", None)
+        return isinstance(device_map, dict) and len(device_map) > 1
+
+    def register_save_state_pre_hook(self, hook: Callable) -> "_RemovableHandle":
+        """``hook(models, weights, output_dir)`` runs at the top of
+        `save_state` (reference `accelerator.py` register_save_state_pre_hook);
+        mutate ``weights`` in place to customize what is persisted."""
+        handle = _RemovableHandle(self._save_state_pre_hooks)
+        self._save_state_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_load_state_pre_hook(self, hook: Callable) -> "_RemovableHandle":
+        """``hook(models, input_dir)`` runs at the top of `load_state`."""
+        handle = _RemovableHandle(self._load_state_pre_hooks)
+        self._load_state_pre_hooks[handle.id] = hook
+        return handle
+
     @contextlib.contextmanager
     def autocast(self, autocast_handler: Any = None):
         """Reference `accelerator.py:3422`. Precision is a functional cast
@@ -1220,14 +1343,21 @@ class Accelerator:
         self._custom_objects.extend(objects)
 
     def save_state(self, output_dir: str | None = None, **save_model_kwargs: Any) -> str:
-        from .checkpointing import save_accelerator_state
+        from .checkpointing import get_checkpoint_dir, save_accelerator_state
 
-        return save_accelerator_state(self, output_dir)
+        resolved = str(get_checkpoint_dir(self, output_dir))  # hooks see the real dir
+        weights = [m.params for m in self._models]
+        for hook in self._save_state_pre_hooks.values():
+            hook(self._models, weights, resolved)  # hooks may replace entries
+        return save_accelerator_state(self, resolved, weights=weights)
 
     def load_state(self, input_dir: str | None = None, **load_model_kwargs: Any) -> None:
-        from .checkpointing import load_accelerator_state
+        from .checkpointing import latest_checkpoint_dir, load_accelerator_state
 
-        load_accelerator_state(self, input_dir)
+        resolved = str(latest_checkpoint_dir(self)) if input_dir is None else str(input_dir)
+        for hook in self._load_state_pre_hooks.values():
+            hook(self._models, resolved)
+        load_accelerator_state(self, resolved)
 
     def save_model(
         self,

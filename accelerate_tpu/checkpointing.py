@@ -135,14 +135,31 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
     return base
 
 
-def save_accelerator_state(accelerator, output_dir: str | None = None) -> str:
-    """Serialize every prepared object's state (reference `checkpointing.py:53-162`)."""
+def latest_checkpoint_dir(accelerator) -> Path:
+    """Most recent automatic checkpoint directory (for load_state(None))."""
+    pc = accelerator.project_configuration
+    base = Path(pc.project_dir or ".") / "checkpoints"
+    candidates = sorted(
+        (d for d in base.iterdir() if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")),
+        key=lambda d: int(d.name.rsplit("_", 1)[1]),
+    ) if base.exists() else []
+    if not candidates:
+        raise FileNotFoundError(f"No checkpoints under {base}")
+    return candidates[-1]
+
+
+def save_accelerator_state(
+    accelerator, output_dir: str | None = None, weights: list | None = None
+) -> str:
+    """Serialize every prepared object's state (reference `checkpointing.py:53-162`).
+    ``weights`` (from the save-state pre-hooks) overrides what is persisted per
+    model, without touching the live params."""
     out = get_checkpoint_dir(accelerator, output_dir)
     state = PartialState()
     out.mkdir(parents=True, exist_ok=True)
 
     for i, model in enumerate(accelerator._models):
-        _save_pytree(out / f"{MODEL_NAME}_{i}", model.params)
+        _save_pytree(out / f"{MODEL_NAME}_{i}", weights[i] if weights is not None else model.params)
         if getattr(model, "extra_state", None) is not None:
             _save_pytree(out / f"{MODEL_NAME}_{i}.extra", model.extra_state)
     for i, opt in enumerate(accelerator._optimizers):
@@ -169,15 +186,7 @@ def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
     """Restore every prepared object (reference `checkpointing.py:165-286`).
     Sharded arrays are re-placed directly onto their mesh positions."""
     if input_dir is None:
-        pc = accelerator.project_configuration
-        base = Path(pc.project_dir or ".") / "checkpoints"
-        candidates = sorted(
-            (d for d in base.iterdir() if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")),
-            key=lambda d: int(d.name.rsplit("_", 1)[1]),
-        )
-        if not candidates:
-            raise FileNotFoundError(f"No checkpoints under {base}")
-        input_dir = str(candidates[-1])
+        input_dir = str(latest_checkpoint_dir(accelerator))
     src = Path(input_dir)
 
     for i, model in enumerate(accelerator._models):

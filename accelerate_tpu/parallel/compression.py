@@ -37,19 +37,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# Mirrors reference `DDPCommunicationHookType` (`utils/dataclasses.py:80-115`)
-COMM_HOOK_TYPES = ("no", "fp16", "bf16", "power_sgd", "batched_power_sgd")
-
-
 class DDPCommunicationHookType(str, Enum):
-    """Reference enum; values interchange with the plain hook-name strings
-    accepted everywhere a hook is configured."""
+    """Mirrors reference `DDPCommunicationHookType` (`utils/dataclasses.py:80-115`);
+    values interchange with the plain hook-name strings accepted everywhere a
+    hook is configured."""
 
     NO = "no"
     FP16 = "fp16"
     BF16 = "bf16"
     POWER_SGD = "power_sgd"
     BATCHED_POWER_SGD = "batched_power_sgd"
+
+
+COMM_HOOK_TYPES = tuple(e.value for e in DDPCommunicationHookType)
 
 
 @dataclass

@@ -53,6 +53,9 @@ class DataLoaderConfiguration(KwargsHandler):
     even_batches: bool = True
     use_seedable_sampler: bool = True
     non_blocking: bool = True  # JAX transfers are always async
+    # parity with reference use_stateful_dataloader: loaders here are ALWAYS
+    # mid-epoch resumable (state_dict/load_state_dict), no torchdata needed
+    use_stateful_dataloader: bool = True
 
 
 @dataclass
