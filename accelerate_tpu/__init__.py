@@ -80,6 +80,15 @@ from .utils.dataclasses import (
     MegatronLMPlugin,
     ProfileKwargs,
 )
+from .utils.operations import ConvertOutputsToFp32, convert_outputs_to_fp32
+from .utils.other import (
+    convert_bytes,
+    extract_model_from_parallel,
+    get_pretty_name,
+    load,
+    save,
+)
+from .commands.config import write_basic_config
 from .utils.random import set_seed, synchronize_rng_states
 from .utils.safetensors_io import (
     load_checkpoint_in_model,

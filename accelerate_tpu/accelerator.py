@@ -1193,22 +1193,9 @@ class Accelerator:
         ``safe_serialization`` (interchange format), anything else to pickle
         with array leaves converted to host numpy. Main process writes; other
         ranks no-op."""
-        if not self.is_main_process:
-            return
-        import pickle
+        from .utils.other import save as _save
 
-        host = jax.tree.map(
-            lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, obj
-        )
-        if safe_serialization:
-            from safetensors.numpy import save_file
-
-            from .utils.safetensors_io import flatten_state_dict
-
-            save_file(flatten_state_dict(host), f)
-            return
-        with open(f, "wb") as fh:
-            pickle.dump(host, fh)
+        _save(obj, f, safe_serialization=safe_serialization)
 
     @property
     def optimizer_step_was_skipped(self) -> bool:
@@ -1310,7 +1297,9 @@ class Accelerator:
     def unwrap_model(self, model: PreparedModel, keep_fp32_wrapper: bool = True) -> Any:
         """Return the original module the user handed to prepare (reference
         `extract_model_from_parallel`, `utils/other.py:64-133`)."""
-        return model.module if isinstance(model, PreparedModel) else model
+        from .utils.other import extract_model_from_parallel
+
+        return extract_model_from_parallel(model, keep_fp32_wrapper=keep_fp32_wrapper)
 
     def get_state_dict(self, model: PreparedModel, unwrap: bool = True) -> Any:
         """Fully-gathered (unsharded) parameter pytree on host (reference

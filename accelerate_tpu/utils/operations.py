@@ -345,6 +345,11 @@ def convert_to_fp32(tensor: Any) -> Any:
     return recursively_apply(_upcast, tensor)
 
 
+def convert_outputs_to_fp32(model_forward: Callable) -> Callable:
+    """Function form of `ConvertOutputsToFp32` (reference `operations.py:769`)."""
+    return ConvertOutputsToFp32(model_forward)
+
+
 class ConvertOutputsToFp32:
     """Picklable callable wrapper that upcasts a function's outputs to fp32
     (reference `ConvertOutputsToFp32`, `operations.py:790-828`)."""

@@ -1,0 +1,101 @@
+"""Small cross-cutting utilities (reference `utils/other.py` role — the
+backend-free subset that has TPU meaning; engine unwrap/save paths collapse
+into `Accelerator.unwrap_model`/`save`)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte size (reference `utils/other.py:convert_bytes`)."""
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(size) < 1024.0:
+            return f"{size:.2f} {unit}"
+        size /= 1024.0
+    return f"{size:.2f} TB"
+
+
+def get_pretty_name(obj: Any) -> str:
+    """Best display name for an object (reference `utils/other.py`)."""
+    if hasattr(obj, "__qualname__"):
+        return obj.__qualname__
+    if hasattr(obj, "__name__"):
+        return obj.__name__
+    return str(type(obj)).split(".")[-1].rstrip("'>")
+
+
+def extract_model_from_parallel(model: Any, keep_fp32_wrapper: bool = True) -> Any:
+    """Unwrap a prepared model back to the user object (reference
+    `extract_model_from_parallel` — DDP/FSDP/compiled unwrapping collapses to
+    returning the original module/apply_fn captured at prepare time). With
+    ``keep_fp32_wrapper`` and an active compute-cast policy, a callable
+    original is returned wrapped so outputs still upcast to fp32 (the
+    reference keeps the autocast forward patch)."""
+    from ..accelerator import PreparedModel
+    from .operations import ConvertOutputsToFp32
+
+    if not isinstance(model, PreparedModel):
+        return model
+    original = model.module
+    if keep_fp32_wrapper and model.policy.enabled and callable(original):
+        return ConvertOutputsToFp32(original)
+    return original
+
+
+def save(obj: Any, f: str, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:
+    """Rank-gated object serialization (reference `utils/other.py:save`).
+    ``save_on_each_node`` writes from every process (shared-filesystem-free
+    clusters); default is main-process-only."""
+    from ..state import PartialState
+
+    state = PartialState()
+    if not (save_on_each_node or state.is_main_process):
+        return
+    host = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, obj
+    )
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        from .safetensors_io import flatten_state_dict
+
+        save_file(flatten_state_dict(host), f)
+        return
+    with open(f, "wb") as fh:
+        pickle.dump(host, fh)
+
+
+def load(f: str, map_location: Any = None, **kwargs: Any) -> Any:
+    """Counterpart of `save` (reference `utils/other.py:load`); safetensors
+    files load via the interchange reader, anything else unpickles."""
+    if _is_safetensors_file(f):
+        from .safetensors_io import load_safetensors_checkpoint
+
+        return load_safetensors_checkpoint(f, nested=True)
+    with open(f, "rb") as fh:
+        return pickle.load(fh)
+
+
+def _is_safetensors_file(f: str) -> bool:
+    """Sniff the safetensors header (8-byte little-endian length + '{') so
+    `load` round-trips whatever `save(..., safe_serialization=True)` wrote,
+    regardless of extension."""
+    if str(f).endswith(".safetensors"):
+        return True
+    import os
+
+    try:
+        size = os.path.getsize(f)
+        with open(f, "rb") as fh:
+            head = fh.read(9)
+    except OSError:
+        return False
+    if len(head) < 9:
+        return False
+    n = int.from_bytes(head[:8], "little")
+    return head[8:9] == b"{" and 0 < n <= size - 8

@@ -154,3 +154,74 @@ def test_find_batch_size_and_listify():
 def test_get_data_structure():
     s = get_data_structure({"a": np.zeros((2, 3), dtype=np.float32)})
     assert s == {"a": ((2, 3), "float32")}
+
+
+def test_misc_other_utils(tmp_path):
+    """utils/other.py surface (reference utils/other.py role)."""
+    import accelerate_tpu as at
+
+    assert at.convert_bytes(512) == "512.00 B"
+    assert at.convert_bytes(3_500_000) == "3.34 MB"
+    assert at.get_pretty_name(at.Accelerator) == "Accelerator"
+
+    at.save({"x": np.arange(3), "meta": "hi"}, str(tmp_path / "o.pkl"))
+    got = at.load(str(tmp_path / "o.pkl"))
+    assert got["meta"] == "hi" and list(got["x"]) == [0, 1, 2]
+
+    at.save({"w": np.ones((2, 2), np.float32)}, str(tmp_path / "w.safetensors"),
+            safe_serialization=True)
+    assert at.load(str(tmp_path / "w.safetensors"))["w"].shape == (2, 2)
+
+
+def _bf16_forward(x):
+    return x.astype(jnp.bfloat16)
+
+
+def test_convert_outputs_to_fp32_function_form():
+    from accelerate_tpu.utils.operations import convert_outputs_to_fp32
+
+    fn = convert_outputs_to_fp32(_bf16_forward)
+    assert fn(jnp.ones(3)).dtype == jnp.float32
+    import pickle as pkl  # wrapper must stay picklable (reference contract)
+
+    assert pkl.loads(pkl.dumps(fn))(jnp.ones(2)).dtype == jnp.float32
+
+
+def test_extract_model_from_parallel_unwraps_prepared():
+    import optax
+
+    import accelerate_tpu as at
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = at.Accelerator()
+    fn = lambda p, x: x @ p["w"]
+    model = acc.prepare((fn, {"w": np.eye(2, dtype=np.float32)}))
+    assert at.extract_model_from_parallel(model) is fn
+
+
+def test_save_load_roundtrip_sniffs_safetensors(tmp_path):
+    """load() must round-trip safe_serialization output regardless of
+    extension (header sniff, not extension dispatch)."""
+    import accelerate_tpu as at
+
+    at.save({"w": np.ones((2, 2), np.float32)}, str(tmp_path / "ckpt.bin"),
+            safe_serialization=True)
+    got = at.load(str(tmp_path / "ckpt.bin"))
+    assert got["w"].shape == (2, 2)
+
+
+def test_unwrap_keeps_fp32_wrapper_under_mixed_precision():
+    import accelerate_tpu as at
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = at.Accelerator(mixed_precision="bf16")
+    fn = _bf16_forward
+    model = acc.prepare((fn, {"w": np.eye(2, dtype=np.float32)}))
+    wrapped = acc.unwrap_model(model)  # keep_fp32_wrapper default True
+    assert wrapped(jnp.ones(3)).dtype == jnp.float32
+    raw = acc.unwrap_model(model, keep_fp32_wrapper=False)
+    assert raw is fn
