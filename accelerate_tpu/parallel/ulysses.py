@@ -46,9 +46,12 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str = "sequence",
     causal: bool = False,
+    window: int | None = None,
     scale: float | None = None,
 ) -> jax.Array:
-    """Call inside shard_map with ``axis_name`` bound; requires H % n == 0."""
+    """Call inside shard_map with ``axis_name`` bound; requires H % n == 0.
+    After the all-to-all each head slice sees the FULL sequence, so
+    ``window`` (sliding-window attention) composes unchanged."""
     n = jax.lax.axis_size(axis_name)
     h = q.shape[2]
     if h % n:
@@ -56,7 +59,7 @@ def ulysses_attention(
     qh = _all_to_all_seq_to_heads(q, axis_name)
     kh = _all_to_all_seq_to_heads(k, axis_name)
     vh = _all_to_all_seq_to_heads(v, axis_name)
-    out = dot_product_attention(qh, kh, vh, causal=causal, scale=scale)
+    out = dot_product_attention(qh, kh, vh, causal=causal, window=window, scale=scale)
     return _all_to_all_heads_to_seq(out, axis_name)
 
 
@@ -66,17 +69,20 @@ def ulysses_attention_sharded(
     v: jax.Array,
     mesh: Mesh,
     causal: bool = False,
+    window: int | None = None,
     scale: float | None = None,
 ) -> jax.Array:
     """shard_map wrapper over the sequence axis (same contract as
     `ring_attention_sharded`)."""
     if mesh.shape.get("sequence", 1) == 1:
-        return dot_product_attention(q, k, v, causal=causal, scale=scale)
+        return dot_product_attention(q, k, v, causal=causal, window=window, scale=scale)
     from jax import shard_map
 
     batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
     spec = P(batch_axes if batch_axes else None, "sequence", None, None)
-    fn = functools.partial(ulysses_attention, axis_name="sequence", causal=causal, scale=scale)
+    fn = functools.partial(
+        ulysses_attention, axis_name="sequence", causal=causal, window=window, scale=scale
+    )
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)(
         q, k, v
     )

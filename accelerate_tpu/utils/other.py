@@ -26,7 +26,7 @@ def get_pretty_name(obj: Any) -> str:
         return obj.__qualname__
     if hasattr(obj, "__name__"):
         return obj.__name__
-    return str(type(obj)).split(".")[-1].rstrip("'>")
+    return type(obj).__qualname__
 
 
 def extract_model_from_parallel(model: Any, keep_fp32_wrapper: bool = True) -> Any:
@@ -42,7 +42,14 @@ def extract_model_from_parallel(model: Any, keep_fp32_wrapper: bool = True) -> A
     if not isinstance(model, PreparedModel):
         return model
     original = model.module
-    if keep_fp32_wrapper and model.policy.enabled and callable(original):
+    if (
+        keep_fp32_wrapper
+        and model.policy.enabled
+        and callable(original)
+        and not hasattr(original, "apply")  # wrapping a flax module would hide
+        # its .apply/.init API; plain forward functions are what the
+        # reference's fp32 forward patch wraps
+    ):
         return ConvertOutputsToFp32(original)
     return original
 
@@ -54,7 +61,8 @@ def save(obj: Any, f: str, save_on_each_node: bool = False, safe_serialization: 
     from ..state import PartialState
 
     state = PartialState()
-    if not (save_on_each_node or state.is_main_process):
+    should_write = state.is_local_main_process if save_on_each_node else state.is_main_process
+    if not should_write:
         return
     host = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x, obj

@@ -275,3 +275,48 @@ class TestGQA:
         k = _rand((1, 64, 3, 32), 40)
         with pytest.raises(ValueError, match="multiple of kv heads"):
             flash_attention(q, k, k, causal=True, triangle_block=32)
+
+
+@pytest.mark.parametrize("nq,block,window", [
+    (1, 64, None), (4, 32, None), (8, 16, None),
+    (4, 32, 1), (4, 32, 32), (4, 32, 40), (8, 16, 100), (8, 16, 1000),
+])
+def test_band_map_enumeration_properties(nq, block, window):
+    """Structural invariants of the scalar-prefetch maps: every in-band block
+    appears exactly once, flags mark exactly the accumulator boundaries, and
+    row/column enumerations cover the same cell set."""
+    from accelerate_tpu.ops.flash_attention import (
+        _band_lo,
+        _band_maps_col,
+        _band_maps_row,
+    )
+
+    expected = {
+        (iq, ik)
+        for iq in range(nq)
+        for ik in range(_band_lo(iq, block, window), iq + 1)
+    }
+
+    iqm, ikm, first, last = _band_maps_row(nq, block, window)
+    cells = list(zip(iqm.tolist(), ikm.tolist()))
+    assert sorted(cells) == sorted(expected)
+    assert len(set(cells)) == len(cells)
+    # row-major: first/last flags fire exactly at each row's band edges
+    for t, (iq, ik) in enumerate(cells):
+        assert first[t] == (ik == _band_lo(iq, block, window))
+        assert last[t] == (ik == iq)
+    # every row flushes exactly once
+    assert sum(last.tolist()) == nq
+
+    iqm2, ikm2, gm2, first2, last2 = _band_maps_col(nq, block, window, groups=2)
+    cells2 = list(zip(gm2.tolist(), iqm2.tolist(), ikm2.tolist()))
+    assert sorted(set((iq, ik) for _, iq, ik in cells2)) == sorted(expected)
+    # each column's pair sequence is contiguous with exactly one first/one last
+    cols = ikm2.tolist()
+    for ik in set(cols):
+        span = [t for t, c in enumerate(cols) if c == ik]
+        assert span == list(range(span[0], span[-1] + 1)), "column not contiguous"
+        assert first2[span[0]] == 1 and last2[span[-1]] == 1
+        assert sum(first2[t] for t in span) == 1 and sum(last2[t] for t in span) == 1
+        # both groups' cells present for this column
+        assert {g for g, _, c in cells2 if c == ik} == {0, 1}

@@ -225,3 +225,33 @@ def test_unwrap_keeps_fp32_wrapper_under_mixed_precision():
     assert wrapped(jnp.ones(3)).dtype == jnp.float32
     raw = acc.unwrap_model(model, keep_fp32_wrapper=False)
     assert raw is fn
+
+
+def test_unwrap_flax_module_keeps_module_api():
+    """A flax module must come back unwrapped even under mixed precision —
+    wrapping would hide .apply/.init (review regression)."""
+    import flax.linen as nn
+
+    import accelerate_tpu as at
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = at.Accelerator(mixed_precision="bf16")
+    m = M()
+    params = m.init(jax.random.key(0), np.ones((1, 4), np.float32))
+    model = acc.prepare((m, params))
+    u = acc.unwrap_model(model)
+    assert u is m and hasattr(u, "apply")
+
+
+def test_get_pretty_name_fallbacks():
+    import accelerate_tpu as at
+
+    assert at.get_pretty_name(5) == "int"
+    assert at.get_pretty_name(at.Accelerator) == "Accelerator"

@@ -53,3 +53,21 @@ def test_ring_trivial_axis_falls_back():
     out = ring_attention_sharded(q, q, q, mesh, causal=True)
     ref = dot_product_attention(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_ulysses_sliding_window_matches_full():
+    """window composes through the Ulysses all-to-all (full sequence visible
+    per head slice after redistribution)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.attention import dot_product_attention
+    from accelerate_tpu.parallel.mesh import ParallelismConfig, build_mesh
+    from accelerate_tpu.parallel.ulysses import ulysses_attention_sharded
+
+    mesh = build_mesh(ParallelismConfig(data_parallel_size=2, sequence_size=4))
+    q = jax.random.normal(jax.random.key(0), (2, 64, 4, 16), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (2, 64, 4, 16), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (2, 64, 4, 16), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, window=20)
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=True, window=20)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
