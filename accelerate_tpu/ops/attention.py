@@ -22,6 +22,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 
 def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32, offset: int = 0) -> jax.Array:
@@ -78,6 +79,36 @@ def dot_product_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def _tp_shard_map(flash_fn, q, k):
+    """Under a live tensor-parallel mesh, run the Pallas kernel per head shard
+    via shard_map: XLA cannot partition a custom call, so without this it
+    all-gathers the sharded activations and computes attention replicated on
+    every device — correct but O(tp) redundant. Returns None when no TP mesh
+    is active or head counts don't divide the axis (caller runs unwrapped)."""
+    from ..state import AcceleratorState
+
+    if not AcceleratorState._shared_state:
+        return None
+    mesh = AcceleratorState().mesh
+    tp = mesh.shape.get("tensor", 1)
+    if tp <= 1:
+        return None
+    hq, hk = q.shape[2], k.shape[2]
+    if hq % tp or hk % tp:
+        return None  # heads don't divide the axis (contiguous sharding keeps
+        # whole GQA groups per shard whenever both counts divide)
+    from jax import shard_map
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    batch_div = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    if q.shape[0] % batch_div:
+        return None  # e.g. batch-1 eval: keep the replicated (correct) path
+    spec = P(batch_axes if batch_axes else None, None, "tensor", None)
+    return shard_map(
+        flash_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -109,9 +140,13 @@ def attention(
 
         # GQA K/V pass through unrepeated — the band grid reads kv head
         # h // groups directly; the rectangular path repeats internally
-        return flash_attention(
-            q, k, v, causal=causal, window=window, block_q=block_q, block_kv=block_kv
+        flash = partial(
+            flash_attention, causal=causal, window=window, block_q=block_q, block_kv=block_kv
         )
+        wrapped = _tp_shard_map(flash, q, k)
+        if wrapped is not None:
+            return wrapped(q, k, v)
+        return flash(q, k, v)
     if k.shape[2] != q.shape[2]:
         groups = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, groups, axis=2)

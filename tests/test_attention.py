@@ -320,3 +320,64 @@ def test_band_map_enumeration_properties(nq, block, window):
         assert sum(first2[t] for t in span) == 1 and sum(last2[t] for t in span) == 1
         # both groups' cells present for this column
         assert {g for g, _, c in cells2 if c == ik} == {0, 1}
+
+
+def test_flash_stays_sharded_under_tensor_parallel():
+    """Under a live TP mesh the dispatcher runs the Pallas kernel per head
+    shard via shard_map — XLA cannot partition a custom call, so unwrapped it
+    would all-gather and compute attention replicated on every device."""
+    import accelerate_tpu as at
+    from accelerate_tpu.ops.attention import attention
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = at.Accelerator(parallelism_config=ParallelismConfig(data_parallel_size=4, tensor_size=2))
+    q = _rand((4, 128, 8, 32), 50)
+    k = _rand((4, 128, 4, 32), 51)  # GQA 2:1
+    v = _rand((4, 128, 4, 32), 52)
+    sh = NamedSharding(acc.mesh, P("data", None, "tensor", None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    @jax.jit
+    def f(q, k, v):
+        return attention(q, k, v, causal=True, window=48, implementation="flash",
+                         block_q=None, block_kv=None)
+
+    import os
+    os.environ["ACCELERATE_TPU_FLASH_TRIANGLE"] = "64"
+    try:
+        out = f(qs, ks, vs)
+    finally:
+        os.environ.pop("ACCELERATE_TPU_FLASH_TRIANGLE", None)
+    assert out.sharding.spec == P("data", None, "tensor", None), out.sharding
+    ref = dot_product_attention(
+        q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), causal=True, window=48
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    # the custom VJP must compose with shard_map (training path)
+    def loss_tp(q, k, v):
+        return (attention(q, k, v, causal=True, implementation="flash",
+                          block_q=None, block_kv=None) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (dot_product_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), causal=True) ** 2).sum()
+
+    g_tp = jax.jit(jax.grad(loss_tp, argnums=(0, 1, 2)))(qs, ks, vs)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_tp, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
+
+    # undivisible batch (e.g. batch-1 eval) must fall back, not crash
+    q1, k1, v1 = q[:1], k[:1], v[:1]
+    out1 = attention(q1, k1, v1, causal=True, implementation="flash",
+                     block_q=None, block_kv=None)
+    ref1 = dot_product_attention(
+        q1, jnp.repeat(k1, 2, axis=2), jnp.repeat(v1, 2, axis=2), causal=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(ref1), atol=2e-5, rtol=2e-5)
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
