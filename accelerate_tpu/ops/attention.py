@@ -85,21 +85,24 @@ def _tp_shard_map(flash_fn, q, k):
     all-gathers the sharded activations and computes attention replicated on
     every device — correct but O(tp) redundant. Returns None when no TP mesh
     is active or head counts don't divide the axis (caller runs unwrapped)."""
+    from ..parallel.mesh import active_batch_axes, inside_shard_map
     from ..state import AcceleratorState
 
-    if not AcceleratorState._shared_state:
-        return None
+    if "mesh" not in AcceleratorState._shared_state:  # initialized check only:
+        return None  # a bare truthiness test could side-effect-init the singleton
     mesh = AcceleratorState().mesh
     tp = mesh.shape.get("tensor", 1)
     if tp <= 1:
         return None
+    if inside_shard_map(mesh):
+        return None  # already per-shard (pipeline/ring region): nesting would fail
     hq, hk = q.shape[2], k.shape[2]
     if hq % tp or hk % tp:
         return None  # heads don't divide the axis (contiguous sharding keeps
         # whole GQA groups per shard whenever both counts divide)
     from jax import shard_map
 
-    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    batch_axes = active_batch_axes(mesh)
     batch_div = math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
     if q.shape[0] % batch_div:
         return None  # e.g. batch-1 eval: keep the replicated (correct) path
@@ -132,6 +135,11 @@ def attention(
         raise ValueError(
             f"q heads ({q.shape[2]}) must be a multiple of kv heads ({k.shape[2]})"
         )
+    if mask is not None and implementation != "xla":
+        # the flash kernel has no arbitrary-mask support; computing over the
+        # masked positions would be silently wrong, so masked calls take the
+        # XLA path regardless of the requested implementation
+        implementation = "xla"
     if implementation == "auto":
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
         implementation = "flash" if (on_tpu and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]) else "xla"

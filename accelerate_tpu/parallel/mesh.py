@@ -108,3 +108,24 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     """Axes over which the global batch is sharded (data + fsdp: FSDP shards both
     parameters and, like ZeRO, the batch — each fsdp group member sees distinct data)."""
     return tuple(n for n in ("data", "fsdp") if mesh.shape.get(n, 1) >= 1)
+
+
+def active_batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """The non-trivial batch-sharding axes — the shard_map in_spec form used by
+    the ring/ulysses/flash wrappers (one definition so they cannot drift)."""
+    return tuple(n for n in ("data", "fsdp") if mesh.shape.get(n, 1) > 1)
+
+
+def inside_shard_map(mesh: Mesh) -> bool:
+    """True when tracing inside a shard_map region that binds any of this
+    mesh's axes — nesting another shard_map over the same mesh there would
+    fail at trace time."""
+    import jax
+
+    for name in mesh.axis_names:
+        try:
+            jax.lax.axis_index(name)
+            return True
+        except Exception:
+            continue
+    return False

@@ -119,7 +119,9 @@ def ring_attention_sharded(
         return dot_product_attention(q, k, v, causal=causal, scale=scale)
     from jax import shard_map
 
-    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    from .mesh import active_batch_axes
+
+    batch_axes = active_batch_axes(mesh)
     spec = P(batch_axes if batch_axes else None, "sequence", None, None)
 
     fn = functools.partial(ring_attention, axis_name="sequence", causal=causal, scale=scale)

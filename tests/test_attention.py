@@ -352,6 +352,18 @@ def test_flash_stays_sharded_under_tensor_parallel():
         out = f(qs, ks, vs)
     finally:
         os.environ.pop("ACCELERATE_TPU_FLASH_TRIANGLE", None)
+    try:
+        _run_tp_shard_assertions(out, f, q, k, v, qs, ks, vs)
+    finally:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+
+
+def _run_tp_shard_assertions(out, f, q, k, v, qs, ks, vs):
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.ops.attention import attention
+
     assert out.sharding.spec == P("data", None, "tensor", None), out.sharding
     ref = dot_product_attention(
         q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2), causal=True, window=48
@@ -379,5 +391,3 @@ def test_flash_stays_sharded_under_tensor_parallel():
     ref1 = dot_product_attention(
         q1, jnp.repeat(k1, 2, axis=2), jnp.repeat(v1, 2, axis=2), causal=True)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(ref1), atol=2e-5, rtol=2e-5)
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
