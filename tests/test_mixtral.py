@@ -99,3 +99,25 @@ def test_capacity_drops_pass_through_residual():
     ids = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 8)), dtype=jnp.int32)
     out = module.apply({"params": params}, ids)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_mixtral_sliding_window_plumbs_through():
+    """MixtralConfig.sliding_window must reach the shared attention stack
+    (HF MixtralConfig.sliding_window role)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    ids = jnp.asarray((np.arange(24)[None, :] % 7).astype(np.int32))
+    outs = {}
+    for w in (None, 4):
+        cfg = MixtralConfig.tiny(dtype=jnp.float32, sliding_window=w, attention_impl="xla")
+        assert cfg.as_llama().sliding_window == w
+        m = MixtralForCausalLM(cfg)
+        params = m.init(jax.random.key(0), ids)["params"]
+        out = m.apply({"params": params}, ids)
+        logits = out[0] if isinstance(out, tuple) else out
+        outs[w] = np.asarray(logits)
+    np.testing.assert_allclose(outs[None][:, :4], outs[4][:, :4], atol=1e-5)
+    assert np.abs(outs[None][:, 10:] - outs[4][:, 10:]).max() > 1e-4
