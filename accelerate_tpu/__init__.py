@@ -68,6 +68,7 @@ from .utils.imports import is_rich_available
 
 if is_rich_available():  # optional extra: keep base import rich-free
     from .utils import rich
+from .utils.deepspeed import DummyOptim, DummyScheduler
 from .utils.dataclasses import (
     AutocastKwargs,
     DataLoaderConfiguration,

@@ -349,6 +349,7 @@ class Accelerator:
         self._schedulers: list[AcceleratedScheduler] = []
         self._dataloaders: list[DataLoaderShard] = []
         self._custom_objects: list[Any] = []
+        self._dummy_optim_map: dict[int, AcceleratedOptimizer] = {}
         # model -> (loss_fn -> jitted grad fn), both levels weakly keyed
         self._grad_fns: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self._train_steps: dict[tuple, Any] = {}
@@ -583,6 +584,8 @@ class Accelerator:
         optax GradientTransformations; dataloaders are torch DataLoaders or batch
         iterables; schedulers expose ``step()``.
         """
+        from .utils.deepspeed import DummyOptim, DummyScheduler
+
         result: list[Any] = [None] * len(args)
         model_indices: list[int] = []
         for obj in args:
@@ -594,6 +597,8 @@ class Accelerator:
                 )
         # pass 1: models and dataloaders
         for i, obj in enumerate(args):
+            if isinstance(obj, (DummyOptim, DummyScheduler)):
+                continue  # passes 2/3
             if isinstance(obj, PreparedModel):
                 result[i] = obj
                 model_indices.append(i)
@@ -614,11 +619,18 @@ class Accelerator:
                 result[i] = self.prepare_data_loader(obj)
             else:
                 result[i] = obj
-        # pass 2: optimizers attach to the (single) model
+        # pass 2: optimizers attach to the (single) model. A DummyOptim's
+        # sibling DummyScheduler (same prepare call) supplies the warmup/total
+        # step counts for 'auto' resolution, matching the reference's joint
+        # engine build (`accelerator.py:1741-1803`).
+        dummy_sched = next((o for o in args if isinstance(o, DummyScheduler)), None)
         for i, obj in enumerate(args):
             if result[i] is not None:
                 continue
-            if _is_optax_tx(obj) or isinstance(obj, AcceleratedOptimizer):
+            if isinstance(obj, DummyOptim):
+                model = result[model_indices[0]] if model_indices else None
+                result[i] = self._prepare_dummy_optim(obj, dummy_sched, model=model)
+            elif _is_optax_tx(obj) or isinstance(obj, AcceleratedOptimizer):
                 model = result[model_indices[0]] if model_indices else None
                 result[i] = self.prepare_optimizer(obj, model=model)
         # pass 3: schedulers attach to optimizers
@@ -673,6 +685,36 @@ class Accelerator:
         self._optimizers.append(prepared)
         return prepared
 
+    def _prepare_dummy_optim(
+        self, dummy, dummy_sched=None, model: PreparedModel | None = None
+    ) -> AcceleratedOptimizer:
+        """Compile a `DummyOptim` (+ sibling `DummyScheduler`) against the
+        deepspeed_plugin's ds_config sections (reference swaps placeholders for
+        engine-built objects in `_prepare_deepspeed`, `accelerator.py:1741-1803`)."""
+        from .utils.deepspeed import build_ds_optimizer, build_ds_schedule
+
+        plugin = self.deepspeed_plugin
+        if plugin is None:
+            raise ValueError(
+                "DummyOptim requires a deepspeed_plugin (its optimizer comes from "
+                "the ds_config 'optimizer' section)."
+            )
+        opt_cfg = getattr(plugin, "optimizer_config", None)
+        sched_cfg = getattr(plugin, "scheduler_config", None)
+        base_lr = dummy.lr
+        if opt_cfg:
+            p = opt_cfg.get("params", {})
+            lr = p.get("lr")
+            if lr is not None and lr != "auto":
+                base_lr = float(lr)
+        schedule_fn = build_ds_schedule(sched_cfg, dummy_sched, base_lr)
+        tx = build_ds_optimizer(opt_cfg, dummy, schedule_fn)
+        prepared = self.prepare_optimizer(tx, model=model)
+        prepared._ds_schedule_fn = schedule_fn
+        prepared._ds_base_lr = base_lr  # the lr the optimizer actually uses
+        self._dummy_optim_map[id(dummy)] = prepared
+        return prepared
+
     def prepare_data_loader(self, data_loader: Any, device_placement: bool | None = None) -> DataLoaderShard:
         if isinstance(data_loader, DataLoaderShard):
             self._dataloaders.append(data_loader)
@@ -693,6 +735,26 @@ class Accelerator:
     def prepare_scheduler(self, scheduler: Any) -> AcceleratedScheduler:
         if isinstance(scheduler, AcceleratedScheduler):
             return scheduler
+        from .utils.deepspeed import DeepSpeedSchedulerView, DummyScheduler
+
+        if isinstance(scheduler, DummyScheduler):
+            opt = self._dummy_optim_map.get(id(scheduler.optimizer))
+            if opt is None:
+                opt = self._optimizers[-1] if self._optimizers else None
+            if opt is None:
+                raise ValueError(
+                    "DummyScheduler must be prepared together with (or after) its "
+                    "DummyOptim — the schedule is embedded in the built optimizer."
+                )
+            schedule_fn = getattr(opt, "_ds_schedule_fn", None)
+            if schedule_fn is None:
+                # constant-lr config: report the ds_config-RESOLVED lr the
+                # optimizer actually runs at, not the placeholder's field
+                base = getattr(opt, "_ds_base_lr", None)
+                if base is None:
+                    base = getattr(scheduler.optimizer, "lr", 0.0) if scheduler.optimizer else 0.0
+                schedule_fn = lambda _count, _base=base: _base  # noqa: E731
+            scheduler = DeepSpeedSchedulerView(schedule_fn, opt)
         prepared = AcceleratedScheduler(
             scheduler,
             optimizers=self._optimizers,
@@ -1140,6 +1202,108 @@ class Accelerator:
             return loss
 
         return step
+
+    # -------------------------------------------------------- pipeline training
+    def prepare_pipeline(
+        self,
+        stage_fn: Callable,
+        per_stage_params: Any,
+        *,
+        pre: tuple[Callable, Any] | None = None,
+        post: tuple[Callable, Any] | None = None,
+        num_microbatches: int = 1,
+        axis_name: str = "stage",
+    ) -> PreparedModel:
+        """Prepare a GPipe pipeline model over the mesh's ``stage`` axis.
+
+        ``per_stage_params`` is a list of per-stage param pytrees (one per
+        pipeline stage, all for the same homogeneous ``stage_fn``) or an
+        already-stacked tree with a leading stage dim. ``pre``/``post`` are
+        optional ``(fn, params)`` pairs for the replicated embedding/head
+        around the pipelined trunk. The returned `PreparedModel` carries
+        stage-axis shardings, so `save_state`/`load_state` round-trip the
+        stage-sharded weights through orbax like any other model, and a
+        prepared optimizer's state lands stage-sharded for free.
+
+        Reference role: Megatron-LM model prep (`utils/megatron_lm.py` pp>1
+        model partitioning) — here a sharding annotation, not an engine.
+        """
+        from .parallel.pipeline import pipeline_apply
+        from .parallel.pipeline_train import build_pipeline_params, stage_shardings
+
+        if self.mesh is None or self.mesh.shape.get(axis_name, 1) <= 1:
+            raise ValueError(
+                f"prepare_pipeline needs a mesh with a non-trivial {axis_name!r} axis "
+                "(ParallelismConfig(stage_size=...))."
+            )
+        pre_fn, pre_params = pre if pre is not None else (None, None)
+        post_fn, post_params = post if post is not None else (None, None)
+        stage_size = self.mesh.shape[axis_name]
+        if isinstance(per_stage_params, list) and len(per_stage_params) != stage_size:
+            raise ValueError(
+                f"got {len(per_stage_params)} per-stage param trees for a mesh "
+                f"with {axis_name} axis size {stage_size}; pipeline stages must "
+                "match the mesh one-to-one."
+            )
+        params = build_pipeline_params(per_stage_params, pre_params, post_params)
+        params = self.policy.cast_to_param(params)
+        shardings = stage_shardings(params, self.mesh, axis_name)
+        if self.device_placement:
+            params = shard_params(params, shardings)
+        mesh = self.mesh
+
+        def apply_fn(p, x):
+            h = pre_fn(p["pre"], x) if pre_fn is not None else x
+            y = pipeline_apply(
+                stage_fn, p["stages"], h, mesh, num_microbatches, axis_name=axis_name
+            )
+            return post_fn(p["post"], y) if post_fn is not None else y
+
+        prepared = PreparedModel(
+            apply_fn,
+            params,
+            policy=self.policy,
+            mesh=mesh,
+            shardings=shardings,
+            module=stage_fn,
+        )
+        self._models.append(prepared)
+        return prepared
+
+    def make_pipeline_train_step(
+        self,
+        stage_fn: Callable,
+        loss_fn: Callable,
+        model: PreparedModel | None = None,
+        optimizer: AcceleratedOptimizer | None = None,
+        *,
+        num_microbatches: int,
+        pre_fn: Callable | None = None,
+        post_fn: Callable | None = None,
+        max_grad_norm: float | None = None,
+        donate: bool = True,
+        axis_name: str = "stage",
+    ) -> Callable:
+        """`make_train_step` sibling for a `prepare_pipeline` model: one jitted
+        SPMD program runs the GPipe microbatch schedule, backward, gradient
+        accumulation and the optimizer tick over the ``stage`` mesh axis
+        (reference Megatron train_step role, `utils/megatron_lm.py:1035-1057`).
+        ``step(batch) -> loss`` with ``batch = (x, targets)``."""
+        from .parallel.pipeline_train import make_pipeline_train_step
+
+        return make_pipeline_train_step(
+            self,
+            stage_fn,
+            loss_fn,
+            model,
+            optimizer,
+            num_microbatches=num_microbatches,
+            pre_fn=pre_fn,
+            post_fn=post_fn,
+            max_grad_norm=max_grad_norm,
+            donate=donate,
+            axis_name=axis_name,
+        )
 
     # ------------------------------------------------------------- collectives
     def gather(self, tensor: Any) -> Any:

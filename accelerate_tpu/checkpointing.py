@@ -122,7 +122,12 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
     base.mkdir(parents=True, exist_ok=True)
     if pc.automatic_checkpoint_naming:
         existing = sorted(
-            (d for d in base.iterdir() if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")),
+            (
+                d
+                for d in base.iterdir()
+                if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")
+                and d.name.rsplit("_", 1)[1].isdigit()
+            ),
             key=lambda d: int(d.name.rsplit("_", 1)[1]),
         )
         if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
@@ -140,7 +145,12 @@ def latest_checkpoint_dir(accelerator) -> Path:
     pc = accelerator.project_configuration
     base = Path(pc.project_dir or ".") / "checkpoints"
     candidates = sorted(
-        (d for d in base.iterdir() if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")),
+        (
+            d
+            for d in base.iterdir()
+            if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")
+            and d.name.rsplit("_", 1)[1].isdigit()
+        ),
         key=lambda d: int(d.name.rsplit("_", 1)[1]),
     ) if base.exists() else []
     if not candidates:

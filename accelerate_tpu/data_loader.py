@@ -357,7 +357,10 @@ def adjust_state_dict_for_prefetch(
             except TypeError:  # Mapping subtypes w/o dict ctor (defaultdict, ...)
                 return items
         if isinstance(node, (list, tuple)):
-            return type(node)(_walk(v) for v in node)
+            walked = [_walk(v) for v in node]
+            if hasattr(node, "_fields"):  # namedtuple: positional ctor
+                return type(node)(*walked)
+            return type(node)(walked)
         return node
 
     return _walk(snapshot)
@@ -661,6 +664,18 @@ class DataLoaderDispatcher(DataLoaderShard):
                 if bs and per * nproc != bs:
                     if self.end_of_dataloader and self.remainder < 0:
                         self.remainder = bs
+                    elif not self.end_of_dataloader and not getattr(self, "_warned_wrap", False):
+                        import warnings
+
+                        warnings.warn(
+                            f"DataLoaderDispatcher: mid-epoch batch of {bs} samples "
+                            f"wrapped to {per * nproc} to fill {nproc} process(es) x "
+                            f"{per} per-process shard; the duplicates are NOT tracked "
+                            "by gather_for_metrics (only the final batch's remainder "
+                            "is). Use batch sizes divisible by the data-axis shard "
+                            "count for exact metrics."
+                        )
+                        self._warned_wrap = True
 
                 def _slice(t):
                     if t.shape[0] != per * nproc:

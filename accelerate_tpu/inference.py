@@ -127,8 +127,12 @@ def prepare_pippy(
 
     def forward(prologue_p, stage_p, epilogue_p, x):
         h = fns[prologue_name](prologue_p, x)
+        # data_axis=None: the pippy contract replicates outputs on every device
+        # ("gather_output" for free) — dp-sharded compute would return sharded
+        # outputs instead
         h = pipeline_apply(
-            stage_fn, stage_p, h, mesh, num_microbatches, axis_name=axis_name
+            stage_fn, stage_p, h, mesh, num_microbatches, axis_name=axis_name,
+            data_axis=None,
         )
         return fns[epilogue_name](epilogue_p, h)
 

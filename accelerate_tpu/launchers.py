@@ -123,7 +123,26 @@ def notebook_launcher(
                 "(unavailable on this OS); a single-node fallback would form a "
                 "wrong-sized world and hang the other nodes."
             )
-        # no fork on this OS: fall back to the importable-function spawn path
+        # no fork on this OS: the spawn fallback re-loads the function's module
+        # file in each child (debug_launcher's runpy path), so cell-defined
+        # closures (the advertised API) cannot work — check debug_launcher's
+        # actual requirements up front and fail naming the real limitation.
+        mod = inspect.getmodule(function)
+        qualname = getattr(function, "__qualname__", getattr(function, "__name__", ""))
+        loadable = (
+            mod is not None
+            and hasattr(mod, "__file__")
+            and "." not in qualname
+            and "<locals>" not in qualname
+        )
+        if not loadable:
+            raise RuntimeError(
+                "notebook_launcher requires the 'fork' start method for "
+                "notebook-cell functions, which this OS does not provide. The "
+                f"spawn fallback re-loads the function's module file, but "
+                f"{qualname!r} is not a module-level function in a file. Move "
+                "it to module level in a .py file, or run on a fork-capable OS."
+            )
         debug_launcher(function, args=args, num_processes=num_processes, platform=None)
         return
 
@@ -235,7 +254,10 @@ def debug_launcher(
             env["JAX_PLATFORMS"] = platform
             if platform == "cpu":
                 env["PALLAS_AXON_POOL_IPS"] = ""
-        if devices_per_process > 1:
+        if platform == "cpu" or devices_per_process > 1:
+            # always pin the count: an inherited parent XLA_FLAGS (e.g. a test
+            # host forcing 8 virtual devices) would otherwise multiply each
+            # child's device count and silently change the data-axis topology
             set_host_device_count_flag(env, devices_per_process)
         procs.append(subprocess.Popen([sys.executable, "-c", runner], env=env))
     codes = [p.wait() for p in procs]

@@ -143,6 +143,13 @@ def attention(
     if implementation == "auto":
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
         implementation = "flash" if (on_tpu and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]) else "xla"
+        if window is not None and implementation == "flash":
+            # the band grid needs a block divisor of seq; un-tileable lengths
+            # (e.g. prime) would raise in the kernel — auto routes them to xla
+            from .flash_attention import band_block_default
+
+            if band_block_default(q.shape[1]) is None:
+                implementation = "xla"
     if implementation == "flash":
         from .flash_attention import flash_attention
 

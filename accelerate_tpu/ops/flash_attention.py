@@ -604,6 +604,15 @@ def _env_block(name: str, default: int) -> int:
     return parse_int_from_env(name, default)
 
 
+def band_block_default(sq: int) -> int | None:
+    """Default band-grid block for a causal/windowed seq: the largest divisor
+    of ``sq`` that is <= 512 (one tiling policy for the kernel and the
+    dispatcher's auto routing). None when the best divisor is < 8 — a band
+    grid that narrow (e.g. prime sq) degenerates to pathological 1-wide tiles."""
+    best = next(b for b in range(min(512, sq), 0, -1) if sq % b == 0)
+    return best if best >= 8 else None
+
+
 def flash_attention(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,
@@ -647,9 +656,18 @@ def flash_attention(
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if triangle_block is None:
-            triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or next(
-                b for b in range(min(512, sq), 0, -1) if sq % b == 0
-            )
+            triangle_block = _env_block("ACCELERATE_TPU_FLASH_TRIANGLE", 0) or None
+            if triangle_block is None:
+                best = band_block_default(sq)
+                if best is None:  # e.g. prime sq: a 1-wide band grid is pathological
+                    raise ValueError(
+                        f"window={window} needs a band grid, but seq {sq} has no "
+                        "block divisor >= 8. Pad the sequence to a tileable "
+                        "length, pass triangle_block explicitly (or via "
+                        "ACCELERATE_TPU_FLASH_TRIANGLE), or use "
+                        "implementation='xla'."
+                    )
+                triangle_block = best
     # An EXPLICIT triangle_block is a strict request: reject configurations it
     # cannot serve rather than silently measuring the rectangular kernel. The
     # env knob is a global default (cross-attention in the same model must

@@ -30,7 +30,9 @@ def _pipeline_local(
     x_mb: jax.Array,  # [M, mb, ...] microbatched input, replicated across stages
     out_fn: Callable | None,
     out_fn_args: Any,
+    out_fn_extra: Any,  # replicated pytree (e.g. head params) forwarded to out_fn
     axis_name: str,
+    data_axis: str | None = None,  # batch-sharding axis: loss is pmean'd over it
 ):
     S = jax.lax.axis_size(axis_name)
     r = jax.lax.axis_index(axis_name)
@@ -61,9 +63,17 @@ def _pipeline_local(
         # replicate the last stage's outputs everywhere (scalar-free generic path)
         mask = (r == S - 1).astype(outs.dtype)
         return jax.lax.psum(outs * mask, axis_name)
-    losses = jax.vmap(lambda y, a: out_fn(y, a))(outs, out_fn_args)  # [M]
+    if out_fn_extra is None:
+        losses = jax.vmap(lambda y, a: out_fn(y, a))(outs, out_fn_args)  # [M]
+    else:
+        losses = jax.vmap(lambda y, a: out_fn(y, a, out_fn_extra))(outs, out_fn_args)
     mask = (r == S - 1).astype(losses.dtype)
-    return jax.lax.psum((losses * mask).mean(), axis_name)
+    loss = jax.lax.psum((losses * mask).mean(), axis_name)
+    if data_axis is not None:
+        # batch sharded over the data axis: the global loss is the mean of the
+        # per-shard means (equal shard sizes by the divisibility gate below)
+        loss = jax.lax.pmean(loss, data_axis)
+    return loss
 
 
 def stage_eval_shape(stage_fn: Callable, params: Any, x: jax.Array) -> jax.Array:
@@ -81,18 +91,30 @@ def pipeline_apply(
     num_microbatches: int,
     out_fn: Callable | None = None,
     out_fn_args: Any = None,
+    out_fn_extra: Any = None,
     axis_name: str = "stage",
+    data_axis: str | None = "data",
 ) -> jax.Array:
     """Run a stage-sharded model as a GPipe pipeline under jit.
 
     ``stage_fn(stage_params, x_mb) -> y_mb`` is one stage's forward on one
     microbatch. With ``out_fn(y_mb, args_mb) -> scalar`` given, returns the mean
     loss (computed on the last stage, psum-broadcast); otherwise returns the
-    stacked outputs [batch, ...].
+    stacked outputs [batch, ...]. ``out_fn_extra`` is an optional replicated
+    pytree (e.g. LM-head parameters) passed as a third argument to ``out_fn`` —
+    it enters the shard_map as an explicit operand so gradients flow to it
+    (closures over tracers inside shard_map are not differentiable operands).
     """
     S = mesh.shape[axis_name]
     if S == 1:
         raise ValueError("pipeline_apply requires a non-trivial stage axis")
+    lead = {l.shape[0] for l in jax.tree.leaves(stacked_params)}
+    if lead and lead != {S}:
+        raise ValueError(
+            f"stacked_params leading (stage) dim {sorted(lead)} must equal the "
+            f"mesh's {axis_name!r} axis size {S} — one param slice per stage "
+            "(extra stages would be silently dropped, missing ones under-shard)."
+        )
     b = x.shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} must divide into {num_microbatches} microbatches")
@@ -106,19 +128,39 @@ def pipeline_apply(
 
     from jax import shard_map
 
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = functools.partial(_pipeline_local, stage_fn, axis_name=axis_name)
+    # Shard the microbatch-sample dim over the data axis when it divides: each
+    # data replica pipelines only its slice (dp x pp composition). Indivisible
+    # shapes fall back to replicated compute — numerically identical, dp-times
+    # redundant — with a warning so the waste is never silent.
+    dp = mesh.shape.get(data_axis, 1) if data_axis is not None else 1
+    use_dp = dp > 1 and mb % dp == 0
+    if dp > 1 and not use_dp:
+        import warnings
 
-    def wrapped(params, x_mb, args_mb):
-        return fn(params, x_mb, out_fn, args_mb)
+        warnings.warn(
+            f"pipeline_apply: microbatch size {mb} not divisible by the "
+            f"{data_axis!r} axis ({dp}); the batch is replicated and every data "
+            "replica redundantly computes the full pipeline."
+        )
+    bspec = P(None, data_axis) if use_dp else P()
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = functools.partial(
+        _pipeline_local,
+        stage_fn,
+        axis_name=axis_name,
+        data_axis=data_axis if (use_dp and out_fn is not None) else None,
+    )
+
+    def wrapped(params, x_mb, args_mb, extra):
+        return fn(params, x_mb, out_fn, args_mb, extra)
 
     result = shard_map(
         wrapped,
         mesh=mesh,
-        in_specs=(param_specs, P(), P()),
-        out_specs=P(),
+        in_specs=(param_specs, bspec, bspec, P()),
+        out_specs=(bspec if out_fn is None else P()),
         check_vma=False,
-    )(stacked_params, x_mb, args_mb)
+    )(stacked_params, x_mb, args_mb, out_fn_extra)
     if out_fn is None:
         return result.reshape(b, *result.shape[2:])
     return result

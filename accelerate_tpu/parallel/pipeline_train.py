@@ -1,0 +1,226 @@
+"""Pipeline-parallel TRAINING: the GPipe schedule fused into the train-step path.
+
+Capability parity: the reference trains pipelined models through Megatron-LM's
+engine (`utils/megatron_lm.py:1035-1057` train_step: forward-backward over
+microbatches, then a single optimizer tick). TPU-native re-founding: the whole
+thing — GPipe ticks, loss, backward, gradient accumulation, adamw update — is
+ONE jitted SPMD program over a ``stage`` mesh axis. `pipeline_apply` is
+reverse-differentiable (scan + ppermute transpose to the reverse schedule), so
+"pipeline backward" is just `jax.grad` of the pipelined loss; stage-sharded
+parameters get stage-sharded gradients and optimizer state by construction.
+
+Model layout: ``params = {"stages": stacked, "pre": ..., "post": ...}`` where
+``stacked`` holds every (homogeneous) stage's weights on a leading stage dim
+(sharded over the ``stage`` axis — each device stores only its stage), and the
+optional ``pre``/``post`` trees (embedding / LM head) are replicated. ``pre``
+runs outside the pipeline on the full microbatched input; ``post`` enters the
+shard_map as an explicit replicated operand so its gradient is a psum over the
+last stage's loss — closures over tracers are not differentiable shard_map
+operands.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .pipeline import pipeline_apply, stack_stage_params
+
+
+def stage_shardings(params: Any, mesh, axis_name: str = "stage") -> Any:
+    """Shardings for a pipeline param tree: ``stages`` leaves on the stage axis
+    (leading dim), everything else replicated."""
+    stage_sh = NamedSharding(mesh, P(axis_name))
+    rep = NamedSharding(mesh, P())
+    return {
+        k: jax.tree.map(lambda _: stage_sh if k == "stages" else rep, v)
+        for k, v in params.items()
+    }
+
+
+def build_pipeline_params(
+    per_stage_params: list[Any] | Any,
+    pre_params: Any = None,
+    post_params: Any = None,
+) -> dict:
+    """Assemble the canonical pipeline param tree. ``per_stage_params`` is a
+    list of per-stage pytrees (stacked here) or an already-stacked tree."""
+    stacked = (
+        stack_stage_params(per_stage_params)
+        if isinstance(per_stage_params, list)
+        else per_stage_params
+    )
+    params = {"stages": stacked}
+    if pre_params is not None:
+        params["pre"] = pre_params
+    if post_params is not None:
+        params["post"] = post_params
+    return params
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    params: dict,
+    x: jax.Array,
+    targets: Any,
+    mesh,
+    num_microbatches: int,
+    *,
+    pre_fn: Callable | None = None,
+    loss_fn: Callable,
+    post_fn: Callable | None = None,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Mean loss of the pipelined model — differentiable wrt every param group.
+
+    ``pre_fn(pre_params, x) -> h`` (optional embedding, replicated),
+    ``stage_fn(stage_params, h_mb) -> h_mb`` (one homogeneous stage),
+    ``post_fn(post_params, y_mb) -> pred_mb`` (optional head, replicated),
+    ``loss_fn(pred_mb, target_mb) -> scalar`` (per-microbatch mean).
+    """
+    h = pre_fn(params["pre"], x) if pre_fn is not None else x
+    post = params.get("post")
+    if post_fn is not None and post is None:
+        raise ValueError("post_fn given but params has no 'post' group")
+
+    if post is None:
+        out_fn = lambda y, t, _=None: loss_fn(y, t)  # noqa: E731
+        extra = None
+    else:
+        out_fn = lambda y, t, pp: loss_fn(post_fn(pp, y) if post_fn else y, t)  # noqa: E731
+        extra = post
+    return pipeline_apply(
+        stage_fn,
+        params["stages"],
+        h,
+        mesh,
+        num_microbatches,
+        out_fn=out_fn,
+        out_fn_args=targets,
+        out_fn_extra=extra,
+        axis_name=axis_name,
+    )
+
+
+def make_pipeline_train_step(
+    accelerator,
+    stage_fn: Callable,
+    loss_fn: Callable,
+    model=None,
+    optimizer=None,
+    *,
+    num_microbatches: int,
+    pre_fn: Callable | None = None,
+    post_fn: Callable | None = None,
+    max_grad_norm: float | None = None,
+    donate: bool = True,
+    axis_name: str = "stage",
+) -> Callable:
+    """Fused jitted GPipe train step over the accelerator's ``stage`` mesh axis.
+
+    Returns ``step(batch) -> loss`` with ``batch = (x, targets)``. Honors
+    gradient accumulation exactly like `Accelerator.make_train_step`: microbatch
+    calls accumulate gradients in a donated buffer; each sync boundary runs one
+    donated update (mean + optional global-norm clip + optax update + apply).
+    The GPipe *microbatches* (``num_microbatches``) live INSIDE one step —
+    gradient accumulation composes on top across steps (SURVEY hard part #4).
+    """
+    from ..accelerator import _clip_tree
+
+    if model is None:
+        model = accelerator._models[0]
+    if optimizer is None:
+        optimizer = accelerator._optimizer_for(model)
+    if max_grad_norm is None:
+        max_grad_norm = accelerator.gradient_clipping
+    mesh = accelerator.mesh
+    if mesh is None or mesh.shape.get(axis_name, 1) <= 1:
+        raise ValueError(
+            f"make_pipeline_train_step needs a mesh with a non-trivial {axis_name!r} "
+            "axis (ParallelismConfig(stage_size=...))."
+        )
+    if getattr(accelerator, "scaler", None) is not None:
+        raise NotImplementedError(
+            "make_pipeline_train_step does not support fp16 dynamic loss scaling "
+            "yet (no inner scale / overflow skip on this path — an overflowed "
+            "microbatch would corrupt params silently). Use bf16 (the TPU "
+            "default) or fp32 for pipeline training."
+        )
+    policy = accelerator.policy
+    tx = optimizer.optimizer
+    param_shardings = getattr(model, "shardings", None)
+
+    def constrain(tree):
+        if param_shardings is None or tree is None:
+            return tree
+        return jax.tree.map(jax.lax.with_sharding_constraint, tree, param_shardings)
+
+    def loss_of(params, batch):
+        x, targets = batch
+        p = policy.cast_to_compute(params)
+        loss = pipeline_loss(
+            stage_fn,
+            p,
+            x,
+            targets,
+            mesh,
+            num_microbatches,
+            pre_fn=pre_fn,
+            loss_fn=loss_fn,
+            post_fn=post_fn,
+            axis_name=axis_name,
+        )
+        return loss.astype(jnp.float32)
+
+    @jax.jit
+    def micro_first(params, batch):
+        loss, grads = jax.value_and_grad(loss_of)(params, batch)
+        return constrain(grads), loss
+
+    # donate the accumulator so HBM holds one gradient copy during accumulation
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(1,) if donate else ())
+    def micro_acc(params, acc, batch):
+        loss, grads = jax.value_and_grad(loss_of)(params, batch)
+        return constrain(jax.tree.map(jnp.add, acc, grads)), loss
+
+    def _update(params, opt_state, acc, batch, inv_k):
+        loss, grads = jax.value_and_grad(loss_of)(params, batch)
+        if acc is not None:
+            grads = jax.tree.map(jnp.add, acc, grads)
+        grads = constrain(jax.tree.map(lambda g: g * inv_k, grads))
+        if max_grad_norm is not None:
+            grads, _ = _clip_tree(grads, max_grad_norm)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = constrain(optax.apply_updates(params, updates))
+        return new_params, new_opt_state, loss
+
+    update = jax.jit(_update, donate_argnums=(0, 1, 2) if donate else ())
+    box = {"acc": None}
+
+    def step(batch: Any) -> jax.Array:
+        accelerator._do_sync()
+        if accelerator.gradient_state.sync_gradients:
+            inv_k = jnp.asarray(
+                1.0 / accelerator.gradient_state.num_steps, dtype=jnp.float32
+            )
+            params, opt_state, loss = update(
+                model.params, optimizer.opt_state, box["acc"], batch, inv_k
+            )
+            model.params = params
+            optimizer.opt_state = opt_state
+            optimizer._num_updates += 1
+            box["acc"] = None
+        else:
+            if box["acc"] is None:
+                box["acc"], loss = micro_first(model.params, batch)
+            else:
+                box["acc"], loss = micro_acc(model.params, box["acc"], batch)
+        return loss
+
+    return step

@@ -138,6 +138,10 @@ class DeepSpeedPlugin(KwargsHandler):
     gradient_clipping: float | None = None
     offload_optimizer_device: str | None = None  # 'cpu' -> host-offloaded opt state
     hf_ds_config: str | None = None  # path to a ds_config.json ('auto' values OK)
+    # raw ds_config optimizer/scheduler sections, kept verbatim ('auto' intact)
+    # for DummyOptim/DummyScheduler compilation (reference utils/deepspeed.py:245-291)
+    optimizer_config: dict | None = None
+    scheduler_config: dict | None = None
 
     def __post_init__(self):
         if self.hf_ds_config:
@@ -172,6 +176,10 @@ class DeepSpeedPlugin(KwargsHandler):
             self.mixed_precision = "bf16"
         elif cfg.get("fp16", {}).get("enabled") is True:
             self.mixed_precision = "fp16"
+        if cfg.get("optimizer"):
+            self.optimizer_config = cfg["optimizer"]
+        if cfg.get("scheduler"):
+            self.scheduler_config = cfg["scheduler"]
 
     def to_parallelism_config(self, num_devices: int) -> ParallelismConfig:
         if self.zero_stage >= 3:

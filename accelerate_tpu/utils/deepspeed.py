@@ -1,0 +1,191 @@
+"""DeepSpeed migration surface: DummyOptim / DummyScheduler placeholders.
+
+Capability parity: reference `utils/deepspeed.py:245-291`. Scripts written for
+DeepSpeed keep the conventional training-loop shape even when the *real*
+optimizer/scheduler are defined in the ds_config JSON — they construct
+`DummyOptim`/`DummyScheduler` placeholders and `accelerator.prepare(...)`
+swaps in the engine-built objects. TPU-native re-founding: there is no engine;
+the ds_config ``optimizer``/``scheduler`` sections are compiled directly to an
+optax `GradientTransformation` with the LR schedule *embedded* (optax folds the
+schedule into the update, advancing with each optimizer tick exactly like
+DeepSpeed's engine-internal scheduler — the reference's
+`DeepSpeedSchedulerWrapper.step()` is a no-op for the same reason).
+
+'auto' entries resolve from the placeholder's own fields (lr, weight_decay,
+warmup/total steps), mirroring the reference's auto-fill contract
+(`utils/deepspeed.py:44-170`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class DummyOptim:
+    """Placeholder optimizer for ds_config-defined optimizers (reference
+    `utils/deepspeed.py:245-265`). ``params`` is accepted for signature parity
+    but unused — optax transformations are parameter-free until `init`."""
+
+    def __init__(self, params: Any = None, lr: float = 0.001, weight_decay: float = 0.0, **kwargs: Any):
+        self.params = params
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.kwargs = kwargs
+
+
+class DummyScheduler:
+    """Placeholder scheduler for ds_config-defined schedulers (reference
+    `utils/deepspeed.py:267-291`). ``lr_scheduler_callable`` (an
+    ``optimizer -> schedule_fn`` factory, or a plain ``step -> lr`` optax
+    schedule) overrides the ds_config section when given."""
+
+    def __init__(
+        self,
+        optimizer: Any = None,
+        total_num_steps: int | None = None,
+        warmup_num_steps: int = 0,
+        lr_scheduler_callable: Callable | None = None,
+        **kwargs: Any,
+    ):
+        self.optimizer = optimizer
+        self.total_num_steps = total_num_steps
+        self.warmup_num_steps = warmup_num_steps
+        self.lr_scheduler_callable = lr_scheduler_callable
+        self.kwargs = kwargs
+
+
+def _resolve(value: Any, fallback: Any) -> Any:
+    return fallback if value is None or value == "auto" else value
+
+
+def build_ds_schedule(
+    scheduler_config: dict | None,
+    dummy_scheduler: DummyScheduler | None,
+    base_lr: float,
+) -> Callable[[int], float] | None:
+    """Compile a ds_config ``scheduler`` section to an optax schedule fn.
+
+    Supported types (DeepSpeed's scheduler zoo): WarmupLR (linear warmup then
+    constant), WarmupDecayLR (warmup then linear decay to 0 at
+    total_num_steps), WarmupCosineLR (warmup then cosine to ``cos_min_ratio``).
+    A `DummyScheduler.lr_scheduler_callable` takes precedence over the section.
+    Returns None when there is nothing to schedule (constant lr).
+    """
+    import optax
+
+    ds = dummy_scheduler
+    if ds is not None and ds.lr_scheduler_callable is not None:
+        fn = ds.lr_scheduler_callable
+        try:  # reference contract: callable(optimizer); optax users pass step->lr
+            candidate = fn(ds.optimizer)
+        except TypeError:
+            candidate = fn
+        return candidate if callable(candidate) else fn
+    if not scheduler_config:
+        return None
+    stype = scheduler_config.get("type", "WarmupLR")
+    p = scheduler_config.get("params", {})
+    warmup = int(_resolve(p.get("warmup_num_steps"), ds.warmup_num_steps if ds else 0))
+    max_lr = float(_resolve(p.get("warmup_max_lr"), base_lr))
+    min_lr = float(_resolve(p.get("warmup_min_lr"), 0.0))
+    total = _resolve(p.get("total_num_steps"), ds.total_num_steps if ds else None)
+    if stype == "WarmupLR":
+        if warmup == 0:  # DeepSpeed semantics: no warmup = constant max_lr
+            return optax.schedules.constant_schedule(max_lr)
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(min_lr, max_lr, warmup),
+             optax.schedules.constant_schedule(max_lr)],
+            [warmup],
+        )
+    if stype == "WarmupDecayLR":
+        if total is None:
+            raise ValueError("WarmupDecayLR needs total_num_steps (ds_config or DummyScheduler)")
+        decay = optax.schedules.linear_schedule(max_lr, 0.0, max(int(total) - warmup, 1))
+        if warmup == 0:
+            return decay
+        return optax.schedules.join_schedules(
+            [optax.schedules.linear_schedule(min_lr, max_lr, warmup), decay],
+            [warmup],
+        )
+    if stype == "WarmupCosineLR":
+        if total is None:
+            raise ValueError("WarmupCosineLR needs total_num_steps (ds_config or DummyScheduler)")
+        cos_min = float(_resolve(p.get("cos_min_ratio"), 1e-4)) * max_lr
+        if warmup == 0:
+            return optax.schedules.cosine_decay_schedule(
+                init_value=max_lr, decay_steps=int(total), alpha=cos_min / max_lr
+            )
+        return optax.schedules.warmup_cosine_decay_schedule(
+            init_value=min_lr, peak_value=max_lr, warmup_steps=warmup,
+            decay_steps=int(total), end_value=cos_min,
+        )
+    raise ValueError(
+        f"Unsupported ds_config scheduler type {stype!r}; supported: WarmupLR, "
+        "WarmupDecayLR, WarmupCosineLR (or pass lr_scheduler_callable)."
+    )
+
+
+def build_ds_optimizer(
+    optimizer_config: dict | None,
+    dummy_optim: DummyOptim,
+    schedule_fn: Callable[[int], float] | None = None,
+):
+    """Compile a ds_config ``optimizer`` section (+ optional embedded schedule)
+    to an optax `GradientTransformation`.
+
+    Supported types: Adam, AdamW (adam_w_mode), SGD, Lamb. 'auto' params fall
+    back to the `DummyOptim`'s fields (reference auto-fill semantics).
+    """
+    import optax
+
+    cfg = optimizer_config or {"type": "AdamW", "params": {}}
+    otype = cfg.get("type", "AdamW")
+    p = cfg.get("params", {})
+    lr = float(_resolve(p.get("lr"), dummy_optim.lr))
+    wd = float(_resolve(p.get("weight_decay"), dummy_optim.weight_decay))
+    learning_rate = schedule_fn if schedule_fn is not None else lr
+    betas = _resolve(p.get("betas"), dummy_optim.kwargs.get("betas", (0.9, 0.999)))
+    eps = float(_resolve(p.get("eps"), dummy_optim.kwargs.get("eps", 1e-8)))
+    name = otype.lower()
+    if name == "adam" and not cfg.get("adam_w_mode", False):
+        # DeepSpeed 'Adam' couples weight decay into the gradient (L2), unlike AdamW
+        tx = optax.adam(learning_rate, b1=betas[0], b2=betas[1], eps=eps)
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name in ("adamw", "adam"):  # adam with adam_w_mode=True is AdamW
+        return optax.adamw(learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name == "sgd":
+        momentum = float(_resolve(p.get("momentum"), dummy_optim.kwargs.get("momentum", 0.0)))
+        tx = optax.sgd(learning_rate, momentum=momentum or None)
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == "lamb":
+        return optax.lamb(learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    raise ValueError(
+        f"Unsupported ds_config optimizer type {otype!r}; supported: Adam, AdamW, SGD, Lamb."
+    )
+
+
+class DeepSpeedSchedulerView:
+    """Torch-scheduler-shaped view over a schedule embedded in the optax
+    optimizer (reference `DeepSpeedSchedulerWrapper`: ``step()`` is a no-op
+    because the engine — here, the optimizer update itself — advances the
+    schedule; `get_last_lr` reads the live update count)."""
+
+    def __init__(self, schedule_fn: Callable[[int], float], optimizer: Any):
+        self.schedule_fn = schedule_fn
+        self.optimizer = optimizer  # AcceleratedOptimizer
+
+    def step(self, *args: Any, **kwargs: Any) -> None:
+        pass  # the optax update advances the embedded schedule
+
+    def get_last_lr(self) -> list[float]:
+        return [float(self.schedule_fn(int(self.optimizer.num_updates)))]
+
+    def state_dict(self) -> dict:
+        return {}  # the count lives in (and restores with) the optimizer state
+
+    def load_state_dict(self, state: dict) -> None:
+        pass

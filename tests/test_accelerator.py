@@ -209,6 +209,24 @@ class TestAcceleratorBasics:
         np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
         assert opt.num_updates == 4
 
+    def test_automatic_naming_ignores_stray_dirs(self, tmp_path):
+        from accelerate_tpu.accelerator import ProjectConfiguration
+        from accelerate_tpu.checkpointing import latest_checkpoint_dir
+
+        acc = _fresh_accelerator(
+            project_config=ProjectConfiguration(
+                project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+            )
+        )
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        # a stray non-integer-suffixed dir must not break naming, rotation, or load
+        (tmp_path / "checkpoints" / "checkpoint_backup").mkdir(parents=True)
+        for _ in range(3):
+            acc.save_state()
+        latest = latest_checkpoint_dir(acc)
+        assert latest.name == "checkpoint_2"
+        acc.load_state(None)
+
     def test_save_model_consolidated(self, tmp_path):
         from accelerate_tpu.checkpointing import load_model_weights
 

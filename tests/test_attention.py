@@ -163,6 +163,15 @@ class TestSlidingWindow:
         for a, b_ in zip(g_band, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4)
 
+    def test_window_untileable_seq_raises(self):
+        # prime seq > 512 has no block divisor >= 8 under the 512 cap: the
+        # default band grid would be 1-wide (pathological) — the kernel must
+        # refuse with guidance instead
+        shape = (1, 1031, 2, 32)
+        q, k, v = _rand(shape, 31), _rand(shape, 32), _rand(shape, 33)
+        with pytest.raises(ValueError, match="block divisor"):
+            flash_attention(q, k, v, causal=True, window=16)
+
     def test_dispatcher_routes_window(self):
         shape = (1, 64, 2, 32)
         q, k, v = _rand(shape, 20), _rand(shape, 21), _rand(shape, 22)

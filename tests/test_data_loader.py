@@ -321,6 +321,19 @@ def test_adjust_state_dict_for_prefetch_structure():
     assert any("sample-unit" in str(c.message) for c in caught)
 
 
+def test_adjust_state_dict_for_prefetch_namedtuple():
+    import collections
+
+    from accelerate_tpu.data_loader import adjust_state_dict_for_prefetch
+
+    Node = collections.namedtuple("Node", ["counters", "tag"])
+    snap = {"nested": Node(counters={"_num_batches_fetched": 9}, tag="x")}
+    got = adjust_state_dict_for_prefetch(snap, 3, batch_size=2)
+    assert isinstance(got["nested"], Node)
+    assert got["nested"].counters["_num_batches_fetched"] == 6
+    assert got["nested"].tag == "x"
+
+
 class TestTorchInterop:
     def test_prepare_torch_dataloader(self):
         import torch
