@@ -131,6 +131,10 @@ class BatchSamplerShard:
         n = len(self.batch_sampler)
         if self.split_batches:
             return n
+        if self.drop_last:
+            # a trailing group with fewer than num_processes batches is dropped
+            # entirely (reference `data_loader.py:199-205` length math)
+            return n // self.num_processes
         if self.even_batches:
             return math.ceil(n / self.num_processes)
         # without evening, later processes may get one fewer batch
@@ -185,11 +189,14 @@ class BatchSamplerShard:
                 group = []
         if not group:
             return
+        if self.drop_last:
+            # incomplete trailing group: dropped whole, never wrapped — torch
+            # DataLoader drop_last semantics extend to the process group
+            return
         if not self.even_batches:
+            # drop_last returned above, so the trailing piece always yields
             if self.process_index < len(group):
-                piece = group[self.process_index]
-                if len(piece) == batch_size or not self.drop_last:
-                    yield piece
+                yield group[self.process_index]
             return
         # even out the trailing partial group by wrapping whole batches from the start
         flat = [i for b in all_batches for i in b]

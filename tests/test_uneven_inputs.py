@@ -1,0 +1,110 @@
+"""Uneven-input semantics property tests (reference
+`test_utils/scripts/external_deps/test_metrics.py` role + Join semantics,
+reference `accelerator.py:1095-1182`): under XLA static shapes the framework
+completes ragged batches by wrapping and records the true count in
+``remainder`` — these tests pin that design to METRICS-EXACTNESS on
+pathological splits: dataset smaller than the shard count, prime sizes, final
+batch of 1, shard vs dispatcher mode.
+
+All sizes run on the 8-device CPU mesh (8 data shards, 1 process): the global
+batch must tile 8 shards, so every ragged case exercises the wrap+remainder
+machinery exactly as a pod topology would.
+"""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.data_loader import DataLoaderDispatcher, prepare_data_loader
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _fresh():
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator()
+
+
+def _torch_loader(n, bs, drop_last=False):
+    import torch
+    import torch.utils.data as tud
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"v": torch.tensor(float(i)), "idx": torch.tensor(i)}
+
+    return tud.DataLoader(DS(), batch_size=bs, shuffle=False, drop_last=drop_last)
+
+
+@pytest.mark.parametrize("n", [1, 3, 5, 7, 8, 9, 23, 29])
+def test_gather_for_metrics_exact_on_pathological_sizes(n):
+    """Dataset sizes below/around the 8-shard mesh: gather_for_metrics must
+    return exactly the dataset — independently computed truth, not a
+    self-comparison."""
+    acc = _fresh()
+    dl = acc.prepare(_torch_loader(n, bs=8))
+    got = [np.asarray(acc.gather_for_metrics(b["idx"])) for b in dl]
+    np.testing.assert_array_equal(np.concatenate(got), np.arange(n))
+
+
+@pytest.mark.parametrize("n", [1, 5, 11, 27])
+def test_dispatcher_metrics_exact_on_pathological_sizes(n):
+    """Same property through the dispatcher (process-0-reads) path."""
+    acc = _fresh()
+    data = np.arange(float(n))
+    batches = [data[i : i + 8] for i in range(0, n, 8)]
+    dl = acc.prepare(DataLoaderDispatcher(batches))
+    got = [np.asarray(acc.gather_for_metrics(b)) for b in dl]
+    np.testing.assert_array_equal(np.concatenate(got), data)
+
+
+@pytest.mark.parametrize("n,bs", [(13, 8), (22, 8), (29, 16)])
+def test_metric_mean_matches_single_process_truth(n, bs):
+    """An accuracy-style metric computed through gather_for_metrics equals the
+    plain single-process computation bit-for-bit (the reference's Join /
+    even_batches=False guarantee, delivered by wrap+remainder instead)."""
+    rng = np.random.default_rng(n)
+    preds = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    truth = float((preds == labels).mean())
+
+    import torch
+    import torch.utils.data as tud
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"preds": torch.tensor(preds[i]), "labels": torch.tensor(labels[i])}
+
+    acc = _fresh()
+    dl = acc.prepare(tud.DataLoader(DS(), batch_size=bs, shuffle=False))
+    hits = total = 0
+    for b in dl:
+        g = acc.gather_for_metrics({"preds": b["preds"], "labels": b["labels"]})
+        hits += int((np.asarray(g["preds"]) == np.asarray(g["labels"])).sum())
+        total += len(np.asarray(g["preds"]))
+    assert total == n
+    assert hits / total == truth
+
+
+def test_remainder_resets_between_epochs():
+    """The duplicate-drop must re-arm every epoch, not just the first."""
+    acc = _fresh()
+    dl = acc.prepare(_torch_loader(11, bs=8))
+    for _ in range(2):
+        got = [np.asarray(acc.gather_for_metrics(b["idx"])) for b in dl]
+        np.testing.assert_array_equal(np.concatenate(got), np.arange(11))
+
+
+def test_join_uneven_inputs_is_documented_noop():
+    """`join_uneven_inputs` exists for API parity and must pass through
+    unchanged (the wrap+remainder design makes Join unnecessary); it warns so
+    nobody relies on torch Join semantics silently."""
+    acc = _fresh()
+    with acc.join_uneven_inputs([object()]):
+        pass
