@@ -67,7 +67,7 @@ def _migrate_legacy(data: dict) -> dict:
 class LaunchConfig:
     """Everything the launcher needs to start a run (reference ClusterConfig)."""
 
-    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD / AMAZON_SAGEMAKER
     num_processes: int = 1  # hosts
     process_id: int = 0
     coordinator_address: str | None = None  # host0:port for jax.distributed
@@ -79,6 +79,8 @@ class LaunchConfig:
     stage_size: int = 1
     gradient_accumulation_steps: int = 1
     debug: bool = False
+    # AMAZON_SAGEMAKER section (reference SageMakerConfig; see commands/sagemaker.py)
+    sagemaker: dict | None = None
 
     def to_yaml(self, path: Path | None = None) -> Path:
         path = path or default_config_file()
@@ -123,11 +125,17 @@ def config_command(args: argparse.Namespace) -> None:
     print("accelerate-tpu configuration")
     cfg = LaunchConfig()
     cfg.compute_environment = _ask(
-        "Compute environment", "LOCAL_MACHINE", ["LOCAL_MACHINE", "TPU_POD"]
+        "Compute environment", "LOCAL_MACHINE",
+        ["LOCAL_MACHINE", "TPU_POD", "AMAZON_SAGEMAKER"],
     )
     if cfg.compute_environment == "TPU_POD":
         cfg.num_processes = int(_ask("Number of hosts (TPU workers)", "1"))
         cfg.coordinator_address = _ask("Coordinator address (host0:port)", "") or None
+    elif cfg.compute_environment == "AMAZON_SAGEMAKER":
+        from .sagemaker import sagemaker_questionnaire, to_dict
+
+        cfg.sagemaker = to_dict(sagemaker_questionnaire(_ask))
+        cfg.num_processes = int(cfg.sagemaker.get("num_machines", 1))
     cfg.mixed_precision = _ask("Mixed precision", "bf16", ["no", "bf16", "fp16", "fp8"])
     cfg.gradient_accumulation_steps = int(_ask("Gradient accumulation steps", "1"))
     cfg.fsdp_size = int(_ask("FSDP (parameter-shard) degree", "1"))

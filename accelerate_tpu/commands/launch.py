@@ -216,6 +216,146 @@ def _supervised_launch(
         )
 
 
+def _render_env_prefix(env: dict[str, str]) -> str:
+    """Render an inline `K=V K=V ...` shell env prefix (one quoting rule for
+    every pod mode)."""
+    import shlex
+
+    return " ".join(f"{k}={shlex.quote(v)}" for k, v in sorted(env.items()))
+
+
+def _render_invocation(
+    python_executable: str, script: str, script_args: list[str], module: bool
+) -> str:
+    import shlex
+
+    invoke = f"{python_executable} {'-m ' if module else ''}{shlex.quote(script)}"
+    if script_args:
+        invoke += " " + " ".join(shlex.quote(a) for a in script_args)
+    return invoke
+
+
+def build_pod_worker_commands(
+    workers: list[str],
+    script: str,
+    script_args: list[str],
+    base_env: dict[str, str],
+    coordinator_port: int = 8476,
+    module: bool = False,
+    ssh_user: str | None = None,
+    python_executable: str = "python",
+) -> list[tuple[str, str, str]]:
+    """Build the (ssh_target, remote_command) pair for every pod worker.
+
+    Pure command construction (testable without SSH): worker i gets the full
+    launcher<->library env contract inline — JAX_COORDINATOR_ADDRESS pointing
+    at worker 0, JAX_NUM_PROCESSES, its JAX_PROCESS_ID — followed by the
+    script invocation. Returns [(worker, ssh_target, remote_command), ...].
+    Reference role: the xla_dist SSH fan-out (`commands/launch.py:887-943`)
+    and the PDSH/hostfile multi-node runner (`:803-853`).
+    """
+    import shlex
+
+    n = len(workers)
+    coordinator = f"{workers[0]}:{coordinator_port}"
+    out: list[tuple[str, str, str]] = []
+    for i, worker in enumerate(workers):
+        env = dict(base_env)
+        env.update(
+            {
+                "JAX_COORDINATOR_ADDRESS": coordinator,
+                "JAX_NUM_PROCESSES": str(n),
+                "JAX_PROCESS_ID": str(i),
+                "ACCELERATE_TPU_NUM_PROCESSES": str(n),
+            }
+        )
+        target = f"{ssh_user}@{worker}" if ssh_user else worker
+        out.append((
+            worker,
+            target,
+            f"{_render_env_prefix(env)} "
+            f"{_render_invocation(python_executable, script, script_args, module)}",
+        ))
+    return out
+
+
+def _pod_ssh_launch(
+    workers: list[str],
+    script: str,
+    script_args: list[str],
+    base_env: dict[str, str],
+    coordinator_port: int,
+    module: bool = False,
+    ssh_user: str | None = None,
+    ssh_executable: str = "ssh",
+    python_executable: str = "python",
+) -> int:
+    """SSH-fan the per-host launch to every worker and wait for all of them.
+
+    One `ssh worker '<env contract> python script.py ...'` per host, started
+    concurrently; the first worker hosts the jax.distributed coordinator. A
+    nonzero exit anywhere is the job's exit (the peers crash out of their
+    collectives, exactly like a failed NCCL rank). ``ssh_executable`` is
+    swappable so the fan-out path itself is rehearsable without real SSH
+    (`--ssh_executable ./local_shim.sh` in tests; reference rehearses its
+    PDSH runner the same way).
+    """
+    cmds = build_pod_worker_commands(
+        workers, script, script_args, base_env,
+        coordinator_port=coordinator_port, module=module, ssh_user=ssh_user,
+        python_executable=python_executable,
+    )
+    procs = []
+    for worker, target, remote in cmds:
+        print(f"[accelerate-tpu launch] {worker}: {remote}", file=sys.stderr)
+        procs.append(subprocess.Popen([ssh_executable, target, remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def _gcloud_pod_launch(args: argparse.Namespace, cfg: LaunchConfig) -> int:
+    """Single-command Cloud TPU pod bringup: gcloud ssh --worker=all runs the
+    same `accelerate-tpu launch` on every pod VM (reference `tpu_pod_launcher`
+    role, `commands/launch.py:887-943`, minus xla_dist).
+
+    The resolved run plan travels as EXPLICIT inner-launch flags, not env:
+    the inner launch recomputes its env from its own flags (flags > env >
+    config), so an env prefix would be clobbered. Crucially, NO
+    JAX_PROCESS_ID/JAX_COORDINATOR_ADDRESS is forwarded — every VM must
+    autodetect its own identity from the TPU metadata (forwarding the caller's
+    process id 0 to all workers would collide the rendezvous)."""
+    import shlex
+
+    inner_flags = [
+        "--mixed_precision", cfg.mixed_precision,
+        "--gradient_accumulation_steps", str(cfg.gradient_accumulation_steps),
+        "--data_parallel_size", str(cfg.data_parallel_size),
+        "--fsdp_size", str(cfg.fsdp_size),
+        "--tensor_size", str(cfg.tensor_size),
+        "--sequence_size", str(cfg.sequence_size),
+        "--stage_size", str(cfg.stage_size),
+    ]
+    if args.module:
+        inner_flags.append("--module")
+    if args.compilation_cache_dir:
+        inner_flags += ["--compilation_cache_dir", args.compilation_cache_dir]
+    inner = (
+        "accelerate-tpu launch "
+        + " ".join(shlex.quote(f) for f in inner_flags)
+        + f" {shlex.quote(args.training_script)}"
+    )
+    if args.training_script_args:
+        inner += " " + " ".join(shlex.quote(a) for a in args.training_script_args)
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+        "--zone", args.zone, "--worker", "all", "--command", inner,
+    ]
+    print("[accelerate-tpu launch] " + " ".join(cmd), file=sys.stderr)
+    return subprocess.run(cmd).returncode
+
+
 def launch_command(args: argparse.Namespace) -> None:
     cfg = LaunchConfig.from_yaml(Path(args.config_file) if args.config_file else None)
     # CLI overrides (flag > env > config file)
@@ -236,8 +376,42 @@ def launch_command(args: argparse.Namespace) -> None:
             setattr(cfg, attr, value)
     if args.debug:
         cfg.debug = True
+    if args.main_process_ip:
+        cfg.coordinator_address = (
+            f"{args.main_process_ip}:{args.main_process_port or 8476}"
+        )
 
     env = launch_env(cfg)
+    if args.compilation_cache_dir:
+        env["JAX_COMPILATION_CACHE_DIR"] = args.compilation_cache_dir
+    # explicit pod flags beat a saved AMAZON_SAGEMAKER compute_environment;
+    # --sagemaker combined with a pod flag is a contradiction, not a precedence
+    if args.sagemaker and (args.workers or args.tpu_name):
+        raise SystemExit("--sagemaker and --workers/--tpu_name are mutually exclusive")
+    if args.sagemaker or (
+        cfg.compute_environment == "AMAZON_SAGEMAKER"
+        and not (args.workers or args.tpu_name)
+    ):
+        from .sagemaker import from_dict, sagemaker_launcher
+
+        sys.exit(sagemaker_launcher(from_dict(cfg.sagemaker), args, env))
+    if args.workers and args.tpu_name:
+        raise SystemExit("--workers and --tpu_name are mutually exclusive pod modes")
+    if args.workers:
+        workers = [w.strip() for w in args.workers.split(",") if w.strip()]
+        rc = _pod_ssh_launch(
+            workers, args.training_script, args.training_script_args, env,
+            coordinator_port=args.coordinator_port,
+            module=args.module,
+            ssh_user=args.ssh_user,
+            ssh_executable=args.ssh_executable,
+            python_executable=args.python_executable,
+        )
+        sys.exit(rc)
+    if args.tpu_name:
+        if not args.zone:
+            raise SystemExit("--tpu_name requires --zone")
+        sys.exit(_gcloud_pod_launch(args, cfg))
     if args.debug_cpu:
         rc = _debug_cpu_launch(
             args.debug_cpu, args.training_script, args.training_script_args, env,
@@ -264,9 +438,22 @@ def launch_command(args: argparse.Namespace) -> None:
 def add_parser(subparsers) -> None:
     p = subparsers.add_parser("launch", help="launch a training script")
     p.add_argument("--config_file", default=None)
-    p.add_argument("--num_processes", type=int, default=None, help="number of hosts")
-    p.add_argument("--process_id", type=int, default=None, help="this host's index")
+    p.add_argument("--num_processes", "--num_machines", type=int, default=None,
+                   dest="num_processes",
+                   help="number of hosts (alias --num_machines: one process "
+                        "per host under SPMD, so machines == processes)")
+    p.add_argument("--process_id", "--machine_rank", type=int, default=None,
+                   dest="process_id", help="this host's index (alias --machine_rank)")
     p.add_argument("--coordinator_address", default=None, help="host0:port")
+    p.add_argument("--main_process_ip", default=None,
+                   help="coordinator host (reference alias; combined with "
+                        "--main_process_port into the coordinator address)")
+    p.add_argument("--main_process_port", type=int, default=None,
+                   help="coordinator port for --main_process_ip")
+    p.add_argument("--compilation_cache_dir", default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(JAX_COMPILATION_CACHE_DIR; the torch.compile "
+                        "cache-dir analogue)")
     p.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
     p.add_argument("--gradient_accumulation_steps", type=int, default=None)
     p.add_argument("--data_parallel_size", "--dp", type=int, default=None, dest="data_parallel_size")
@@ -286,6 +473,27 @@ def add_parser(subparsers) -> None:
     p.add_argument("--monitor_interval", type=float, default=1.0,
                    help="seconds between child liveness checks under --max_restarts")
     p.add_argument("--module", action="store_true", help="treat script as a python module")
+    # -------- first-class multi-host pod bringup (reference launch.py:803-943)
+    p.add_argument("--workers", default=None, metavar="HOST1,HOST2,...",
+                   help="SSH-fan the launch to these hosts; worker 0 hosts the "
+                        "jax.distributed coordinator")
+    p.add_argument("--coordinator_port", type=int, default=8476,
+                   help="with --workers: port for the coordinator on worker 0")
+    p.add_argument("--ssh_user", default=None, help="with --workers: ssh as this user")
+    p.add_argument("--ssh_executable", default="ssh",
+                   help="with --workers: ssh command to use (swap in a shim to "
+                        "rehearse the fan-out locally)")
+    p.add_argument("--python_executable", default="python",
+                   help="with --workers: interpreter to run on each host")
+    p.add_argument("--tpu_name", default=None,
+                   help="Cloud TPU pod name: run this same launch on every pod "
+                        "VM via gcloud ssh --worker=all")
+    p.add_argument("--zone", default=None, help="GCE zone for --tpu_name")
+    p.add_argument("--sagemaker", action="store_true",
+                   help="submit the script as an Amazon SageMaker training job "
+                        "(config's sagemaker section provides role/instances)")
+    p.add_argument("--dry_run", action="store_true",
+                   help="with --sagemaker: print the job spec without submitting")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     p.set_defaults(func=launch_command)

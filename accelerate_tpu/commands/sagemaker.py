@@ -1,0 +1,225 @@
+"""Amazon SageMaker launch surface (reference `commands/config/sagemaker.py` +
+`utils/launch.py:504-618` prepare_sagemager_args_inputs / sagemaker_launcher).
+
+TPU-native re-founding: SageMaker's accelerator fleet for JAX is Trainium/
+Inferentia (`ml.trn1.*`) or GPU instances running the JAX DLC — either way the
+launch contract is identical to the reference's: turn the training script +
+config into an estimator job spec (entry point, source dir, role, instances,
+hyperparameters from the script args, the ACCELERATE_TPU_* env contract) and
+submit it. Job-spec construction is pure and fully tested; submission needs
+the `sagemaker` SDK and AWS credentials, and degrades to printing the exact
+spec + an actionable message when the SDK is absent (nothing in this image may
+pip-install boto3/sagemaker).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+
+@dataclass
+class SageMakerConfig:
+    """Reference `config_args.py:SageMakerConfig`, trimmed to the fields that
+    mean something for a JAX job (no dynamo/pytorch-version pins)."""
+
+    ec2_instance_type: str = "ml.trn1.32xlarge"
+    iam_role_name: str = ""
+    image_uri: str | None = None  # JAX DLC or custom image
+    profile: str | None = None
+    region: str = "us-east-1"
+    num_machines: int = 1
+    base_job_name: str = "accelerate-tpu-sagemaker"
+    sagemaker_inputs_file: str | None = None
+    sagemaker_metrics_file: str | None = None
+    additional_args: dict = field(default_factory=dict)
+
+
+def _convert_nargs_to_dict(nargs: list[str]) -> dict[str, Any]:
+    """Script args -> estimator hyperparameters (reference
+    `utils/launch.py:462-501` contract, including the no-store_true rule)."""
+
+    def _infer(s: str) -> Any:
+        try:
+            f = float(s)
+            return int(f) if f == int(f) else f
+        except ValueError:
+            return s
+
+    out: dict[str, Any] = {}
+    i = 0
+    while i < len(nargs):
+        arg = nargs[i]
+        if not arg.startswith("-"):
+            raise ValueError(f"Positional script arg {arg!r} cannot become a hyperparameter")
+        key = arg.lstrip("-")
+        if "=" in key:
+            key, value = key.split("=", 1)
+            out[key] = _infer(value)
+            i += 1
+            continue
+        def _is_number(s: str) -> bool:
+            try:
+                float(s)
+                return True
+            except ValueError:
+                return False
+
+        # a following token is a VALUE if it doesn't look like a flag — and a
+        # negative number (-3, -1e-4) is a value, not a flag
+        if i + 1 >= len(nargs) or (
+            nargs[i + 1].startswith("-") and not _is_number(nargs[i + 1])
+        ):
+            raise ValueError(
+                "SageMaker does not support store_true/store_false script flags; "
+                f"give {arg!r} an explicit value (reference launch.py:485 rule)."
+            )
+        out[key] = _infer(nargs[i + 1])
+        i += 2
+    return out
+
+
+def _parse_inputs_file(path: str | None) -> dict[str, str] | None:
+    """Tab-separated `channel\ts3://uri` lines (reference `launch.py:570-585`)."""
+    if not path:
+        return None
+    inputs: dict[str, str] = {}
+    with open(path) as f:
+        for ln, line in enumerate(f):
+            if not line.strip() or line.startswith("#"):
+                continue
+            parts = line.split("\t") if "\t" in line else line.split()
+            if len(parts) != 2:
+                raise ValueError(f"{path}:{ln + 1}: expected '<channel>\\t<s3-uri>'")
+            inputs[parts[0].strip()] = parts[1].strip()
+    return inputs or None
+
+
+def _parse_metrics_file(path: str | None) -> list[dict[str, str]] | None:
+    """Tab-separated `name\tregex` lines (reference `launch.py:587-600`)."""
+    if not path:
+        return None
+    metrics: list[dict[str, str]] = []
+    with open(path) as f:
+        for ln, line in enumerate(f):
+            if not line.strip() or line.startswith("#"):
+                continue
+            parts = line.split("\t") if "\t" in line else line.split(None, 1)
+            if len(parts) != 2:
+                raise ValueError(f"{path}:{ln + 1}: expected '<name>\\t<regex>'")
+            metrics.append({"Name": parts[0].strip(), "Regex": parts[1].strip()})
+    return metrics or None
+
+
+def prepare_sagemaker_job(
+    cfg: SageMakerConfig,
+    training_script: str,
+    script_args: list[str],
+    launch_env: dict[str, str],
+) -> dict[str, Any]:
+    """Pure job-spec builder (reference `prepare_sagemager_args_inputs`):
+    estimator kwargs + channel inputs, ready for `sagemaker.estimator.Estimator`
+    or an `aws sagemaker create-training-job` translation."""
+    source_dir = os.path.dirname(training_script) or "."
+    entry_point = os.path.basename(training_script)
+    if not entry_point.endswith(".py"):
+        raise ValueError(f"Training script must be a .py file, got {entry_point!r}")
+    if not cfg.iam_role_name:
+        raise ValueError("SageMakerConfig.iam_role_name is required (execution role)")
+    environment = dict(launch_env)
+    environment["ACCELERATE_TPU_USE_SAGEMAKER"] = "true"
+    if cfg.num_machines > 1:
+        environment["ACCELERATE_TPU_NUM_PROCESSES"] = str(cfg.num_machines)
+    spec: dict[str, Any] = {
+        "estimator": {
+            "entry_point": entry_point,
+            "source_dir": source_dir,
+            "role": cfg.iam_role_name,
+            "instance_count": cfg.num_machines,
+            "instance_type": cfg.ec2_instance_type,
+            "base_job_name": cfg.base_job_name,
+            "environment": environment,
+            "hyperparameters": _convert_nargs_to_dict(script_args),
+            **({"image_uri": cfg.image_uri} if cfg.image_uri else {}),
+            **(cfg.additional_args or {}),
+        },
+        "region": cfg.region,
+        **({"profile": cfg.profile} if cfg.profile else {}),
+    }
+    metrics = _parse_metrics_file(cfg.sagemaker_metrics_file)
+    if metrics:
+        spec["estimator"]["metric_definitions"] = metrics
+    inputs = _parse_inputs_file(cfg.sagemaker_inputs_file)
+    if inputs:
+        spec["inputs"] = inputs
+    return spec
+
+
+def sagemaker_launcher(
+    cfg: SageMakerConfig,
+    args: argparse.Namespace,
+    launch_env: dict[str, str],
+) -> int:
+    """Submit (or, without the SDK, print) the SageMaker job (reference
+    `sagemaker_launcher`, `utils/launch.py:603-618`)."""
+    spec = prepare_sagemaker_job(cfg, args.training_script, args.training_script_args, launch_env)
+    if getattr(args, "dry_run", False):
+        # dry run NEVER submits, with or without the SDK installed
+        print(json.dumps(spec, indent=2))
+        return 0
+    os.environ.setdefault("AWS_DEFAULT_REGION", cfg.region)
+    if cfg.profile:
+        os.environ.setdefault("AWS_PROFILE", cfg.profile)
+    try:
+        from sagemaker.estimator import Estimator  # type: ignore
+    except ImportError:
+        print(json.dumps(spec, indent=2))
+        print(
+            "\nThe `sagemaker` SDK is not installed in this environment; the job "
+            "spec above is what would be submitted. Install `sagemaker` (and AWS "
+            "credentials) on a machine with network access, or pass --dry_run to "
+            "only print the spec.",
+        )
+        return 1
+    if not cfg.image_uri:
+        raise ValueError(
+            "SageMakerConfig.image_uri is required for submission — there is no "
+            "default JAX container resolved automatically; point it at a JAX "
+            "DLC or your own training image."
+        )
+    estimator = Estimator(**spec["estimator"])
+    estimator.fit(inputs=spec.get("inputs"))
+    print(f"Submitted SageMaker job: {estimator.latest_training_job.name}")
+    return 0
+
+
+def sagemaker_questionnaire(ask) -> SageMakerConfig:
+    """Interactive SageMaker section (reference `commands/config/sagemaker.py`
+    questionnaire, minus the boto3 IAM-role creation — roles are provided, not
+    created, in a no-network environment)."""
+    cfg = SageMakerConfig()
+    cfg.region = ask("AWS region", cfg.region)
+    cfg.profile = ask("AWS profile (empty: env credentials)", "") or None
+    cfg.iam_role_name = ask("SageMaker execution role name/ARN", "")
+    cfg.ec2_instance_type = ask("EC2 instance type", cfg.ec2_instance_type)
+    cfg.num_machines = int(ask("Number of machines", str(cfg.num_machines)))
+    cfg.image_uri = ask(
+        "Training image URI (a JAX DLC or custom image; required to submit)", ""
+    ) or None
+    cfg.base_job_name = ask("Base job name", cfg.base_job_name)
+    cfg.sagemaker_inputs_file = ask("SageMaker inputs file (empty: none)", "") or None
+    cfg.sagemaker_metrics_file = ask("SageMaker metrics file (empty: none)", "") or None
+    return cfg
+
+
+def to_dict(cfg: SageMakerConfig) -> dict:
+    return asdict(cfg)
+
+
+def from_dict(data: dict | None) -> SageMakerConfig:
+    data = data or {}
+    known = {k: v for k, v in data.items() if k in SageMakerConfig.__dataclass_fields__}
+    return SageMakerConfig(**known)

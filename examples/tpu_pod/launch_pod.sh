@@ -1,12 +1,9 @@
 #!/usr/bin/env bash
-# Multi-host launch on a Cloud TPU pod slice — the TPU-native analogue of the
-# reference's examples/slurm/submit_multinode.sh (same role: show the exact
-# incantation that turns N machines into one training job).
-#
-# One process per TPU VM host owns all of that host's chips (SPMD); there is
-# no per-core forking and no RANK/MASTER_ADDR plumbing. On Cloud TPU,
-# jax.distributed discovers the coordinator from the TPU metadata, so the env
-# contract below is only needed off-GCP or to override.
+# Multi-host launch on a Cloud TPU pod slice — now a single CLI call:
+# `accelerate-tpu launch --tpu_name ... --zone ...` runs the same launch on
+# every pod VM via gcloud ssh --worker=all (jax.distributed autodetects the
+# coordinator from TPU metadata). For a plain SSH cluster use
+# `accelerate-tpu launch --workers host1,host2,... script.py` instead.
 #
 # Usage: ./launch_pod.sh <tpu-name> <zone> <script.py> [script args...]
 set -euo pipefail
@@ -16,9 +13,4 @@ ZONE=${2:?gce zone}
 SCRIPT=${3:?training script}
 shift 3
 
-# `accelerate-tpu tpu-config` wraps: gcloud compute tpus tpu-vm ssh $TPU_NAME
-#   --zone $ZONE --worker=all --command "accelerate-tpu launch $SCRIPT ..."
-exec accelerate-tpu tpu-config \
-  --tpu_name "$TPU_NAME" \
-  --zone "$ZONE" \
-  --command "cd \$(dirname $SCRIPT) && accelerate-tpu launch $SCRIPT $*"
+exec accelerate-tpu launch --tpu_name "$TPU_NAME" --zone "$ZONE" "$SCRIPT" "$@"
