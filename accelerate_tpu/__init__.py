@@ -43,6 +43,7 @@ from .utils.operations import (
     reduce,
     send_to_device,
 )
+from .ops.fp8 import DelayedScalingRecipe, Fp8Dense, adamw_fp8
 from .utils.precision import DynamicGradScaler, PrecisionPolicy
 from .utils.quantization import (
     QuantizationConfig,

@@ -348,10 +348,15 @@ def _gcloud_pod_launch(args: argparse.Namespace, cfg: LaunchConfig) -> int:
     )
     if args.training_script_args:
         inner += " " + " ".join(shlex.quote(a) for a in args.training_script_args)
-    cmd = [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
-        "--zone", args.zone, "--worker", "all", "--command", inner,
-    ]
+    # one gcloud-invocation builder for both surfaces (tpu-config + launch)
+    from .tpu import build_gcloud_command
+
+    cmd = build_gcloud_command(
+        argparse.Namespace(
+            tpu_name=args.tpu_name, zone=args.zone, command=inner,
+            training_script=None, install_accelerate=False,
+        )
+    )
     print("[accelerate-tpu launch] " + " ".join(cmd), file=sys.stderr)
     return subprocess.run(cmd).returncode
 

@@ -46,7 +46,7 @@ def _convert_nargs_to_dict(nargs: list[str]) -> dict[str, Any]:
         try:
             f = float(s)
             return int(f) if f == int(f) else f
-        except ValueError:
+        except (ValueError, OverflowError):  # non-numeric, or inf (int(inf) raises)
             return s
 
     out: dict[str, Any] = {}
@@ -82,36 +82,35 @@ def _convert_nargs_to_dict(nargs: list[str]) -> dict[str, Any]:
     return out
 
 
-def _parse_inputs_file(path: str | None) -> dict[str, str] | None:
-    """Tab-separated `channel\ts3://uri` lines (reference `launch.py:570-585`)."""
-    if not path:
-        return None
-    inputs: dict[str, str] = {}
+def _parse_tsv_pairs(path: str, what: str) -> list[tuple[str, str]]:
+    """Tab-(or whitespace-)separated `key<TAB>value` lines, comments/#/blank
+    skipped — the shared shape of the inputs and metrics files (reference
+    `launch.py:570-600`)."""
+    pairs: list[tuple[str, str]] = []
     with open(path) as f:
         for ln, line in enumerate(f):
-            if not line.strip() or line.startswith("#"):
-                continue
-            parts = line.split("\t") if "\t" in line else line.split()
-            if len(parts) != 2:
-                raise ValueError(f"{path}:{ln + 1}: expected '<channel>\\t<s3-uri>'")
-            inputs[parts[0].strip()] = parts[1].strip()
-    return inputs or None
-
-
-def _parse_metrics_file(path: str | None) -> list[dict[str, str]] | None:
-    """Tab-separated `name\tregex` lines (reference `launch.py:587-600`)."""
-    if not path:
-        return None
-    metrics: list[dict[str, str]] = []
-    with open(path) as f:
-        for ln, line in enumerate(f):
-            if not line.strip() or line.startswith("#"):
+            if not line.strip() or line.lstrip().startswith("#"):
                 continue
             parts = line.split("\t") if "\t" in line else line.split(None, 1)
             if len(parts) != 2:
-                raise ValueError(f"{path}:{ln + 1}: expected '<name>\\t<regex>'")
-            metrics.append({"Name": parts[0].strip(), "Regex": parts[1].strip()})
-    return metrics or None
+                raise ValueError(f"{path}:{ln + 1}: expected '{what}'")
+            pairs.append((parts[0].strip(), parts[1].strip()))
+    return pairs
+
+
+def _parse_inputs_file(path: str | None) -> dict[str, str] | None:
+    """`channel\ts3://uri` lines (reference `launch.py:570-585`)."""
+    if not path:
+        return None
+    return dict(_parse_tsv_pairs(path, "<channel>\\t<s3-uri>")) or None
+
+
+def _parse_metrics_file(path: str | None) -> list[dict[str, str]] | None:
+    """`name\tregex` lines (reference `launch.py:587-600`)."""
+    if not path:
+        return None
+    pairs = _parse_tsv_pairs(path, "<name>\\t<regex>")
+    return [{"Name": k, "Regex": v} for k, v in pairs] or None
 
 
 def prepare_sagemaker_job(

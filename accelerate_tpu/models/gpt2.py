@@ -51,6 +51,10 @@ class GPT2Config:
     remat_policy: str | None = None  # see utils/remat.py: full|dots|dots_no_batch
     scan_layers: bool = False
     attention_impl: str = "auto"  # 'xla' | 'flash' | 'auto'
+    # fp8 projections (reference TE convert_model role): a DelayedScalingRecipe
+    # switches every block Dense to ops/fp8.Fp8Dense (delayed-scaling fp8
+    # matmuls; scaling state rides the mutable fp8_meta collection)
+    fp8_recipe: Any = None
 
     @classmethod
     def small(cls, **kw) -> "GPT2Config":
@@ -70,6 +74,20 @@ class GPT2Config:
         return cls(**{**dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=2), **kw})
 
 
+def _dense(cfg: GPT2Config, features: int, name: str) -> nn.Module:
+    """Block projection factory: plain Dense, or Fp8Dense when the config
+    carries an fp8 recipe (reference `transformer_engine.py:26-82`
+    convert_model role — same param names, so checkpoints stay compatible)."""
+    if cfg.fp8_recipe is not None:
+        from ..ops.fp8 import Fp8Dense
+
+        return Fp8Dense(
+            features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            recipe=cfg.fp8_recipe, name=name,
+        )
+    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+
+
 class SelfAttention(nn.Module):
     config: GPT2Config
 
@@ -78,7 +96,7 @@ class SelfAttention(nn.Module):
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.n_head
-        qkv = nn.Dense(3 * e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
+        qkv = _dense(cfg, 3 * e, "qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
@@ -118,7 +136,7 @@ class SelfAttention(nn.Module):
         else:
             out = attention(q, k, v, causal=True, implementation=cfg.attention_impl)
         out = out.reshape(b, s, e)
-        out = nn.Dense(e, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="proj")(out)
+        out = _dense(cfg, e, "proj")(out)
         if cfg.dropout > 0.0 and not deterministic:
             out = nn.Dropout(cfg.dropout)(out, deterministic=False)
         return out
@@ -131,9 +149,9 @@ class MLP(nn.Module):
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
         cfg = self.config
         hidden = cfg.mlp_ratio * cfg.n_embd
-        x = nn.Dense(hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="up")(x)
+        x = _dense(cfg, hidden, "up")(x)
         x = nn.gelu(x, approximate=True)
-        x = nn.Dense(cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="down")(x)
+        x = _dense(cfg, cfg.n_embd, "down")(x)
         if cfg.dropout > 0.0 and not deterministic:
             x = nn.Dropout(cfg.dropout)(x, deterministic=False)
         return x
@@ -186,7 +204,9 @@ class GPT2LMHead(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, deterministic, decode), None),
-                variable_axes={"params": 0},
+                # fp8_meta (per-layer delayed-scaling state) stacks on the same
+                # leading layer axis as the params
+                variable_axes={"params": 0, "fp8_meta": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layer,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -209,7 +229,12 @@ class GPT2LMHead(nn.Module):
     def init_params(self, rng: jax.Array, batch: int = 2, seq: int | None = None) -> Any:
         seq = seq or min(self.config.n_positions, 128)
         dummy = jnp.zeros((batch, seq), dtype=jnp.int32)
-        return self.init(rng, dummy)["params"]
+        variables = self.init(rng, dummy)
+        if len(variables) > 1:
+            # mutable collections (fp8_meta scaling state) ride along; prepare()
+            # splits them into PreparedModel.extra_state
+            return dict(variables)
+        return variables["params"]
 
 
 def gpt2_sharding_rules() -> ShardingRules:

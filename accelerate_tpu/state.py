@@ -43,6 +43,32 @@ class DistributedType(str):
     MULTI_HOST = "MULTI_HOST"  # >1 JAX process
 
 
+def _sagemaker_env_to_contract() -> None:
+    """Translate SageMaker's cluster env (SM_HOSTS JSON list + SM_CURRENT_HOST,
+    set inside every training container) into the JAX_COORDINATOR/PROCESS_ID
+    contract — JAX has no SageMaker autodetect, and without this a
+    num_machines>1 job would run N duplicate single-process trainings
+    (reference role: `utils/launch.py` SageMaker env plumbing)."""
+    if os.environ.get("ACCELERATE_TPU_USE_SAGEMAKER") != "true":
+        return
+    hosts_raw, current = os.environ.get("SM_HOSTS"), os.environ.get("SM_CURRENT_HOST")
+    if not hosts_raw or not current or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return
+    import json as _json
+
+    try:
+        hosts = sorted(_json.loads(hosts_raw))
+    except ValueError:
+        logger.warning("SM_HOSTS is not JSON (%r); skipping cluster translation", hosts_raw)
+        return
+    if len(hosts) <= 1 or current not in hosts:
+        return
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"{hosts[0]}:8476"
+    os.environ["JAX_NUM_PROCESSES"] = str(len(hosts))
+    os.environ["JAX_PROCESS_ID"] = str(hosts.index(current))
+    os.environ["ACCELERATE_TPU_NUM_PROCESSES"] = str(len(hosts))
+
+
 def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
     """Initialize jax.distributed from the launcher env contract if present.
 
@@ -53,6 +79,7 @@ def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
     ``initialization_timeout`` comes from ``InitProcessGroupKwargs.timeout_seconds``
     (reference `InitProcessGroupKwargs.timeout` -> init_process_group).
     """
+    _sagemaker_env_to_contract()
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("ACCELERATE_TPU_NUM_PROCESSES")
     if coord is None and nproc is None:

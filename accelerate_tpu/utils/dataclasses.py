@@ -110,6 +110,20 @@ class FP8RecipeKwargs(KwargsHandler):
     fp8_format: str = "HYBRID"  # E4M3 fwd / E5M2 bwd
     amax_history_len: int = 1024
     amax_compute_algo: str = "max"
+    backend: str = "native"  # "native" fp8-storage dot | "qdq" rounding simulation
+    # MS-AMP-role optimizer level (reference accelerator.py:2015-2057):
+    # "O1" fp32 optimizer state; "O2" e4m3 mu + scaled-fp16 nu (ops/fp8.py:adamw_fp8)
+    opt_level: str = "O1"
+
+    def to_recipe(self):
+        from ..ops.fp8 import DelayedScalingRecipe
+
+        return DelayedScalingRecipe(
+            margin=self.margin,
+            amax_history_len=self.amax_history_len,
+            fp8_format=self.fp8_format,
+            backend=self.backend,
+        )
 
 
 @dataclass

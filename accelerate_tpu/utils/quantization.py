@@ -281,6 +281,30 @@ def quantize_model(model: Any, config: QuantizationConfig):
     raise TypeError(f"Cannot quantize object of type {type(model)}")
 
 
+class QuantizedModule:
+    """Flax-module shim for quantized weights in jitted pipelines (the
+    `Linear4bit` role for the *generation* path): `apply` dequantizes
+    `QuantizedTensor` leaves on entry — inside jit, so XLA fuses the
+    unpack+scale into the consuming matmuls and HBM holds only the packed
+    payload. Hashable by identity, so it works as a jit static argument
+    (e.g. `models.generation.generate(QuantizedModule(m), qparams, ...)`)."""
+
+    def __init__(self, module: Any):
+        self.module = module
+
+    def init(self, *args: Any, **kwargs: Any) -> Any:
+        return self.module.init(*args, **kwargs)
+
+    def apply(self, variables: Any, *args: Any, **kwargs: Any) -> Any:
+        variables = dict(variables)
+        if "params" in variables:
+            variables["params"] = dequantize_params(variables["params"])
+        return self.module.apply(variables, *args, **kwargs)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.module, name)
+
+
 def load_and_quantize_model(
     module: Any,
     weights_location: str,

@@ -15,6 +15,8 @@ Env knobs (all optional):
   BENCH_FUSED_CE    1: lax.scan chunked head+CE; 2: Pallas fused-CE kernel
                     (both avoid the full [b,s,V] logits tensor)
   BENCH_CE_CHUNK    fused-CE row-chunk size (default 1024)
+  BENCH_FP8         model: fp8-storage block matmuls (ops/fp8 native backend);
+                    opt: adamw_fp8 O2 optimizer states; all: both
   BENCH_PREFETCH=1  feed batches through the native C++ staging ring
   BENCH_TIMEOUT     watchdog seconds (default 540): if the device never
                     responds (e.g. dead TPU tunnel), print an error JSON line
@@ -179,19 +181,25 @@ def main() -> None:
     attn = os.environ.get("BENCH_ATTN", "flash" if on_tpu else "xla")
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     remat = os.environ.get("BENCH_REMAT", "")
+    fp8 = os.environ.get("BENCH_FP8", "")
+    fp8_model_kw = {}
+    if fp8 in ("model", "all", "1"):
+        from accelerate_tpu.ops.fp8 import DelayedScalingRecipe
+
+        fp8_model_kw = {"fp8_recipe": DelayedScalingRecipe(backend="native")}
     # GPT-2 on one v5e chip; CPU fallback uses a tiny config so CI completes
     model_name = os.environ.get("BENCH_MODEL", "small")
     if on_tpu:
         cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
         cfg = cfg_cls(
             dtype=jnp.bfloat16, attention_impl=attn, scan_layers=scan,
-            remat=bool(remat), remat_policy=remat or None,
+            remat=bool(remat), remat_policy=remat or None, **fp8_model_kw,
         )
         batch = _env_int("BENCH_BATCH", 8)
         seq = _env_int("BENCH_SEQ", 1024)
         iters = _env_int("BENCH_ITERS", 30)
     else:
-        cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=scan)
+        cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=scan, **fp8_model_kw)
         batch = _env_int("BENCH_BATCH", 8)
         seq = _env_int("BENCH_SEQ", 64)
         iters = _env_int("BENCH_ITERS", 5)
@@ -205,7 +213,13 @@ def main() -> None:
     mu_dtype = os.environ.get("BENCH_MU_DTYPE") or None
     if mu_dtype == "bf16":  # accept the common shorthand; optax needs the full name
         mu_dtype = "bfloat16"
-    model, opt = acc.prepare((module, params), optax.adamw(1e-4, mu_dtype=mu_dtype))
+    if fp8 in ("opt", "all"):
+        from accelerate_tpu.ops.fp8 import adamw_fp8
+
+        tx = adamw_fp8(1e-4, opt_level="O2")
+    else:
+        tx = optax.adamw(1e-4, mu_dtype=mu_dtype)
+    model, opt = acc.prepare((module, params), tx)
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0")
     if fused_ce == "1":
         import functools
@@ -266,6 +280,7 @@ def main() -> None:
             "scan": scan,
             "remat": remat or "off",
             "fused_ce": fused_ce,
+            "fp8": fp8 or "off",
             "platform": jax.devices()[0].platform,
             "loss": round(final_loss, 4),
         },

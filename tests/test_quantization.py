@@ -151,3 +151,56 @@ class TestQuantizedModelForward:
             )
         out = apply_fn(qp, x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.1)
+
+
+class TestQuantizedGeneration:
+    """QuantizedModule: weight-only-quantized autoregressive decode (reference
+    bnb Linear4bit generation role — the headline inference workload)."""
+
+    def _setup(self, qtype):
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+        from accelerate_tpu.utils.quantization import QuantizedModule, quantize_params
+
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+        qcfg = QuantizationConfig(
+            load_in_4bit=qtype != "int8",
+            load_in_8bit=qtype == "int8",
+            quant_type=qtype if qtype != "int8" else "nf4",
+            min_weight_size=1,
+            compute_dtype=jnp.float32,
+        )
+        return module, params, QuantizedModule(module), quantize_params(params, qcfg)
+
+    @pytest.mark.parametrize("qtype", ["nf4", "int8"])
+    def test_quantized_generate_runs(self, qtype):
+        from accelerate_tpu.models.generation import generate
+
+        module, params, qmodule, qparams = self._setup(qtype)
+        prompt = jnp.ones((2, 8), jnp.int32)
+        out = generate(qmodule, qparams, prompt, max_new_tokens=6)
+        assert out.shape == (2, 6)
+        assert (np.asarray(out) >= 0).all()
+
+    def test_int8_logits_match_dense(self):
+        """The quantized module's decode-path logits track the dense model at
+        int8 rounding error (greedy tokens can flip on the near-uniform logits
+        of a random-init model, so fidelity is asserted on logits)."""
+        module, params, qmodule, qparams = self._setup("int8")
+        ids = jnp.ones((1, 8), jnp.int32)
+        dense = module.apply({"params": params}, ids)
+        quant = qmodule.apply({"params": qparams}, ids)
+        np.testing.assert_allclose(
+            np.asarray(quant), np.asarray(dense), rtol=0.15, atol=0.15
+        )
+
+    def test_payload_stays_packed(self):
+        from accelerate_tpu.utils.quantization import QuantizedTensor, quantized_nbytes
+
+        module, params, qmodule, qparams = self._setup("nf4")
+        packed = quantized_nbytes(qparams)
+        dense = sum(l.nbytes for l in jax.tree.leaves(params))
+        assert packed < dense / 3  # 4-bit payload + scales vs fp32
+        assert any(isinstance(l, QuantizedTensor)
+                   for l in jax.tree.leaves(qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)))

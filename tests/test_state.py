@@ -79,3 +79,36 @@ def test_split_between_processes_dict():
     data = {"x": np.arange(6), "y": np.arange(6) * 2}
     with state.split_between_processes(data) as piece:
         np.testing.assert_array_equal(piece["x"], np.arange(6))
+
+
+def test_sagemaker_env_translates_to_jax_contract(monkeypatch):
+    """SM_HOSTS/SM_CURRENT_HOST become the JAX coordinator contract so a
+    num_machines>1 SageMaker job forms one world instead of N duplicates."""
+    import json
+
+    from accelerate_tpu.state import _sagemaker_env_to_contract
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("ACCELERATE_TPU_USE_SAGEMAKER", "true")
+    monkeypatch.setenv("SM_HOSTS", json.dumps(["algo-2", "algo-1"]))
+    monkeypatch.setenv("SM_CURRENT_HOST", "algo-2")
+    _sagemaker_env_to_contract()
+    import os
+
+    assert os.environ["JAX_COORDINATOR_ADDRESS"] == "algo-1:8476"
+    assert os.environ["JAX_NUM_PROCESSES"] == "2"
+    assert os.environ["JAX_PROCESS_ID"] == "1"  # sorted order
+
+
+def test_sagemaker_env_noop_outside_sagemaker(monkeypatch):
+    from accelerate_tpu.state import _sagemaker_env_to_contract
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "ACCELERATE_TPU_USE_SAGEMAKER"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SM_HOSTS", '["a", "b"]')
+    monkeypatch.setenv("SM_CURRENT_HOST", "a")
+    _sagemaker_env_to_contract()
+    import os
+
+    assert "JAX_COORDINATOR_ADDRESS" not in os.environ

@@ -15,6 +15,9 @@ Env:
   BENCH_INF_TOKENS   new tokens to generate (default 20)
   BENCH_INF_CKPT     checkpoint dir (default /tmp/bench_inference_<preset>;
                      created on first run, reused after)
+  BENCH_INF_QUANT    nf4 | fp4 | int8: weight-only quantized decode (the
+                     reference's bnb rows) — packed payload in HBM, dequant
+                     fused into the matmuls via QuantizedModule
 
 The checkpoint is synthetic (zeros): load-time and s/token depend on bytes
 and shapes, not values, and zeros keep corpus creation fast. The reference's
@@ -74,12 +77,32 @@ def main() -> None:
 
     n_params = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
 
-    # ---- load phase: disk -> host -> device, cast to compute dtype
+    quant = os.environ.get("BENCH_INF_QUANT", "")
+
+    # ---- load phase: disk -> host -> (quantize) -> device
     t0 = time.perf_counter()
     host_params = load_safetensors_checkpoint(ckpt, nested=True)
-    params = jax.tree.map(
-        lambda a: jax.device_put(jnp.asarray(a, dtype=cfg.param_dtype)), host_params
-    )
+    if quant:
+        from accelerate_tpu.utils.quantization import (
+            QuantizationConfig,
+            QuantizedModule,
+            quantize_params,
+            quantized_nbytes,
+        )
+
+        qcfg = QuantizationConfig(
+            load_in_4bit=quant in ("nf4", "fp4"),
+            load_in_8bit=quant == "int8",
+            quant_type=quant if quant in ("nf4", "fp4") else "nf4",
+            compute_dtype=cfg.dtype,
+        )
+        params = quantize_params(host_params, qcfg)
+        params = jax.tree.map(jax.device_put, params)
+        module = QuantizedModule(module)
+    else:
+        params = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a, dtype=cfg.param_dtype)), host_params
+        )
     jax.block_until_ready(params)
     load_s = time.perf_counter() - t0
     del host_params
@@ -102,6 +125,12 @@ def main() -> None:
         "unit": "s/token",
         "detail": {
             "preset": preset,
+            "quant": quant or "fp16",
+            **(
+                {"packed_gb": round(quantized_nbytes(params) / 1e9, 3)}
+                if quant
+                else {}
+            ),
             "params_b": round(n_params / 1e9, 3),
             "load_s": round(load_s, 4),
             "s_per_token": round(s_per_token, 5),

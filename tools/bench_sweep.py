@@ -37,6 +37,10 @@ SWEEP: list[dict[str, str]] = [
     {"BENCH_MU_DTYPE": "bfloat16"},
     {"BENCH_MU_DTYPE": "bfloat16", "BENCH_FUSED_CE": "2",
      "ACCELERATE_TPU_FLASH_TRIANGLE": "512"},
+    # round-4 additions: fp8 matmuls / MS-AMP O2 optimizer states
+    {"BENCH_FP8": "model"},
+    {"BENCH_FP8": "opt"},
+    {"BENCH_FP8": "all", "BENCH_FUSED_CE": "2"},
 ]
 
 
