@@ -681,6 +681,27 @@ class Accelerator:
                     "prepare_optimizer needs `model=` when zero or multiple models are prepared."
                 )
             model = self._models[0]
+        if getattr(self.fp8_recipe_handler, "opt_level", "O1") == "O2":
+            # a user-supplied optax transformation cannot be rewritten into the
+            # fp8-state form — say so instead of silently ignoring the recipe
+            from .ops.fp8 import ScaleByAdamFp8State  # noqa: F401
+
+            probe = jax.eval_shape(optimizer.init, {"w": jnp.zeros((1,))})
+            if not any(
+                isinstance(s, ScaleByAdamFp8State)
+                for s in jax.tree.leaves(
+                    probe, is_leaf=lambda s: isinstance(s, ScaleByAdamFp8State)
+                )
+            ):
+                import warnings
+
+                warnings.warn(
+                    "FP8RecipeKwargs(opt_level='O2') is configured, but the "
+                    "optimizer passed to prepare() does not carry fp8 state. "
+                    "Construct it with accelerate_tpu.adamw_fp8(..., "
+                    "opt_level='O2') (or define it in a ds_config and use "
+                    "DummyOptim) to get the low-precision moments."
+                )
         prepared = AcceleratedOptimizer(optimizer, model=model, scaler=self.scaler)
         self._optimizers.append(prepared)
         return prepared
@@ -708,7 +729,8 @@ class Accelerator:
             if lr is not None and lr != "auto":
                 base_lr = float(lr)
         schedule_fn = build_ds_schedule(sched_cfg, dummy_sched, base_lr)
-        tx = build_ds_optimizer(opt_cfg, dummy, schedule_fn)
+        fp8_opt_level = getattr(self.fp8_recipe_handler, "opt_level", "O1") or "O1"
+        tx = build_ds_optimizer(opt_cfg, dummy, schedule_fn, fp8_opt_level=fp8_opt_level)
         prepared = self.prepare_optimizer(tx, model=model)
         prepared._ds_schedule_fn = schedule_fn
         prepared._ds_base_lr = base_lr  # the lr the optimizer actually uses

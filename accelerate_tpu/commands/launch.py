@@ -351,12 +351,7 @@ def _gcloud_pod_launch(args: argparse.Namespace, cfg: LaunchConfig) -> int:
     # one gcloud-invocation builder for both surfaces (tpu-config + launch)
     from .tpu import build_gcloud_command
 
-    cmd = build_gcloud_command(
-        argparse.Namespace(
-            tpu_name=args.tpu_name, zone=args.zone, command=inner,
-            training_script=None, install_accelerate=False,
-        )
-    )
+    cmd = build_gcloud_command(args.tpu_name, args.zone, command=inner)
     print("[accelerate-tpu launch] " + " ".join(cmd), file=sys.stderr)
     return subprocess.run(cmd).returncode
 
@@ -391,6 +386,20 @@ def launch_command(args: argparse.Namespace) -> None:
         env["JAX_COMPILATION_CACHE_DIR"] = args.compilation_cache_dir
     # explicit pod flags beat a saved AMAZON_SAGEMAKER compute_environment;
     # --sagemaker combined with a pod flag is a contradiction, not a precedence
+    if args.hostfile:
+        if args.workers:
+            raise SystemExit("--workers and --hostfile are mutually exclusive")
+        # DeepSpeed hostfile shape: "hostname slots=N" per line; SPMD runs one
+        # process per host so the slot count is informational only
+        hosts = []
+        with open(args.hostfile) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    hosts.append(line.split()[0])
+        if not hosts:
+            raise SystemExit(f"hostfile {args.hostfile} contains no hosts")
+        args.workers = ",".join(hosts)
     if args.sagemaker and (args.workers or args.tpu_name):
         raise SystemExit("--sagemaker and --workers/--tpu_name are mutually exclusive")
     if args.sagemaker or (
@@ -482,6 +491,10 @@ def add_parser(subparsers) -> None:
     p.add_argument("--workers", default=None, metavar="HOST1,HOST2,...",
                    help="SSH-fan the launch to these hosts; worker 0 hosts the "
                         "jax.distributed coordinator")
+    p.add_argument("--hostfile", default=None, metavar="PATH",
+                   help="PDSH/DeepSpeed-style hostfile (one host per line, "
+                        "'slots=N' annotations ignored — one process per host "
+                        "under SPMD); alternative to --workers")
     p.add_argument("--coordinator_port", type=int, default=8476,
                    help="with --workers: port for the coordinator on worker 0")
     p.add_argument("--ssh_user", default=None, help="with --workers: ssh as this user")

@@ -12,21 +12,34 @@ import argparse
 import subprocess
 
 
-def build_gcloud_command(args: argparse.Namespace) -> list[str]:
-    inner = args.command or "accelerate-tpu launch " + (args.training_script or "")
+def build_gcloud_command(
+    tpu_name: str,
+    zone: str,
+    command: str | None = None,
+    training_script: str | None = None,
+    install_accelerate: bool = False,
+) -> list[str]:
+    """The one gcloud `tpus tpu-vm ssh --worker=all` builder — shared by
+    `tpu-config` and `launch --tpu_name` (explicit kwargs, so neither caller
+    is coupled to the other's argparse surface)."""
+    inner = command or "accelerate-tpu launch " + (training_script or "")
     cmd = [
-        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
-        "--zone", args.zone,
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        "--zone", zone,
         "--worker", "all",
         "--command", inner,
     ]
-    if args.install_accelerate:
+    if install_accelerate:
         cmd[-1] = f"pip install accelerate-tpu; {inner}"
     return cmd
 
 
 def tpu_command(args: argparse.Namespace) -> None:
-    cmd = build_gcloud_command(args)
+    cmd = build_gcloud_command(
+        args.tpu_name, args.zone, command=args.command,
+        training_script=args.training_script,
+        install_accelerate=args.install_accelerate,
+    )
     print("Running:", " ".join(cmd))
     if not args.dry_run:
         subprocess.run(cmd, check=True)

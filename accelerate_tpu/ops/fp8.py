@@ -54,6 +54,14 @@ class DelayedScalingRecipe:
     fp8_format: str = "HYBRID"  # E4M3 fwd / E5M2 bwd; "E4M3" uses e4m3 both ways
     backend: str = "native"  # "native" | "qdq"
 
+    def __post_init__(self):
+        if self.backend not in ("native", "qdq"):
+            raise ValueError(
+                f"DelayedScalingRecipe.backend must be 'native' or 'qdq', got "
+                f"{self.backend!r} — a typo here would silently measure the "
+                "wrong matmul path."
+            )
+
 
 def new_meta(history_len: int) -> dict[str, jax.Array]:
     """Fresh per-tensor scaling state: scale + rolling amax history."""

@@ -129,12 +129,15 @@ def build_ds_optimizer(
     optimizer_config: dict | None,
     dummy_optim: DummyOptim,
     schedule_fn: Callable[[int], float] | None = None,
+    fp8_opt_level: str = "O1",
 ):
     """Compile a ds_config ``optimizer`` section (+ optional embedded schedule)
     to an optax `GradientTransformation`.
 
     Supported types: Adam, AdamW (adam_w_mode), SGD, Lamb. 'auto' params fall
     back to the `DummyOptim`'s fields (reference auto-fill semantics).
+    ``fp8_opt_level="O2"`` (from `FP8RecipeKwargs.opt_level`) builds Adam-family
+    optimizers with fp8/fp16-carried moments (`ops/fp8.adamw_fp8`, MS-AMP role).
     """
     import optax
 
@@ -147,6 +150,13 @@ def build_ds_optimizer(
     betas = _resolve(p.get("betas"), dummy_optim.kwargs.get("betas", (0.9, 0.999)))
     eps = float(_resolve(p.get("eps"), dummy_optim.kwargs.get("eps", 1e-8)))
     name = otype.lower()
+    if fp8_opt_level == "O2" and name in ("adam", "adamw"):
+        from ..ops.fp8 import adamw_fp8
+
+        return adamw_fp8(
+            learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+            opt_level="O2",
+        )
     if name == "adam" and not cfg.get("adam_w_mode", False):
         # DeepSpeed 'Adam' couples weight decay into the gradient (L2), unlike AdamW
         tx = optax.adam(learning_rate, b1=betas[0], b2=betas[1], eps=eps)

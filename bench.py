@@ -182,8 +182,12 @@ def main() -> None:
     scan = os.environ.get("BENCH_SCAN", "0") == "1"
     remat = os.environ.get("BENCH_REMAT", "")
     fp8 = os.environ.get("BENCH_FP8", "")
+    if fp8 == "1":  # boolean-style enable means the full feature
+        fp8 = "all"
+    if fp8 not in ("", "model", "opt", "all"):
+        raise SystemExit(f"BENCH_FP8 must be model|opt|all, got {fp8!r}")
     fp8_model_kw = {}
-    if fp8 in ("model", "all", "1"):
+    if fp8 in ("model", "all"):
         from accelerate_tpu.ops.fp8 import DelayedScalingRecipe
 
         fp8_model_kw = {"fp8_recipe": DelayedScalingRecipe(backend="native")}

@@ -721,3 +721,44 @@ class TestSageMaker:
                 ap.Namespace(training_script="t.py", training_script_args=[], dry_run=False),
                 {},
             )
+
+
+def test_hostfile_fan_out(tmp_path):
+    """PDSH/DeepSpeed hostfile (reference commands/launch.py:803-853 role):
+    'host slots=N' lines become the --workers list, rehearsed through the same
+    local-shim fan-out that forms a real 2-process world."""
+    shim = tmp_path / "fake_ssh.sh"
+    shim.write_text("#!/bin/sh\nshift\nexec sh -c \"$1\"\n")
+    shim.chmod(0o755)
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("# my cluster\n127.0.0.1 slots=8\n127.0.0.1 slots=8\n")
+    script = tmp_path / "worker_script.py"
+    script.write_text(
+        "from accelerate_tpu.state import PartialState\n"
+        "state = PartialState()\n"
+        "assert state.num_processes == 2, state.num_processes\n"
+        "print('hostfile worker', state.process_index, 'OK')\n"
+    )
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    out = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.cli", "launch",
+         "--hostfile", str(hostfile),
+         "--coordinator_port", str(port),
+         "--ssh_executable", str(shim),
+         "--python_executable", sys.executable,
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    assert out.stdout.count("OK") == 2, out.stdout

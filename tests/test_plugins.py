@@ -292,3 +292,51 @@ class TestDummyOptimScheduler:
             build_ds_optimizer({"type": "OneBitAdam"}, DummyOptim(None))
         with pytest.raises(ValueError, match="Unsupported"):
             build_ds_schedule({"type": "OneCycle"}, DummyScheduler(None), 0.1)
+
+
+class TestFp8OptLevelWiring:
+    """FP8RecipeKwargs.opt_level reaches the built optimizer (ds_config path)
+    or warns loudly (user-supplied optimizer) — never silently ignored."""
+
+    def test_dummy_optim_gets_fp8_states_at_o2(self, tmp_path):
+        from accelerate_tpu import DummyOptim
+        from accelerate_tpu.ops.fp8 import ScaleByAdamFp8State
+        from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+
+        cfg = _ds_config(tmp_path, optimizer={"type": "AdamW", "params": {"lr": 0.01}})
+        acc = _fresh(
+            deepspeed_plugin=DeepSpeedPlugin(hf_ds_config=cfg),
+            kwargs_handlers=[FP8RecipeKwargs(opt_level="O2")],
+        )
+        model, opt = acc.prepare(
+            (regression_apply_fn, regression_model_params()), DummyOptim(None)
+        )
+        assert any(
+            isinstance(s, ScaleByAdamFp8State)
+            for s in jax.tree.leaves(
+                opt.opt_state, is_leaf=lambda s: isinstance(s, ScaleByAdamFp8State)
+            )
+        )
+
+    def test_user_optimizer_warns_at_o2(self):
+        import warnings as w
+
+        from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+
+        acc = _fresh(kwargs_handlers=[FP8RecipeKwargs(opt_level="O2")])
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            acc.prepare((regression_apply_fn, regression_model_params()), optax.adamw(1e-3))
+        assert any("adamw_fp8" in str(c.message) for c in caught)
+
+    def test_fp8_optimizer_no_warning(self):
+        import warnings as w
+
+        from accelerate_tpu import adamw_fp8
+        from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+
+        acc = _fresh(kwargs_handlers=[FP8RecipeKwargs(opt_level="O2")])
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            acc.prepare((regression_apply_fn, regression_model_params()), adamw_fp8(1e-3))
+        assert not any("adamw_fp8" in str(c.message) for c in caught)
