@@ -233,3 +233,31 @@ class TestPipelineTraining:
         x, t = self._data(n_batches=1)[0]
         losses = [float(step((x, t))) for _ in range(20)]
         assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_bf16_policy_composes(self):
+        """Pipeline training under mixed_precision=bf16: compute in bf16,
+        fp32 masters, finite decreasing loss."""
+        import jax.numpy as jnp
+
+        acc = _pp_accelerator(mixed_precision="bf16")
+        model, opt, _ = self._setup(acc, lr=1e-1)
+        step = acc.make_pipeline_train_step(
+            _stage_fn, _mse, num_microbatches=M, pre_fn=_pre_fn, post_fn=_post_fn
+        )
+        x, t = self._data(n_batches=1)[0]
+        losses = [float(step((x, t))) for _ in range(10)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        assert jax.tree.leaves(model.params)[0].dtype == jnp.float32  # masters
+
+    def test_fp16_scaler_rejected(self):
+        """The pipeline step has no loss-scaling path; it must refuse fp16
+        rather than corrupt params on an overflowed microbatch."""
+        import pytest as _pytest
+
+        acc = _pp_accelerator(mixed_precision="fp16")
+        self._setup(acc)
+        with _pytest.raises(NotImplementedError, match="fp16"):
+            acc.make_pipeline_train_step(
+                _stage_fn, _mse, num_microbatches=M, pre_fn=_pre_fn, post_fn=_post_fn
+            )

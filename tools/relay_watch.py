@@ -1,0 +1,97 @@
+"""Relay watcher: probe hourly (the PERF_NOTES wedge-safe cadence) and launch
+the bench sweep the moment the relay answers.
+
+Runs as the SINGLE device-touching process while the relay is wedged — a
+timed-out probe is itself a mid-op kill, so more frequent probing keeps the
+relay wedged (docs/PERF_NOTES.md round-3 addendum). On the first successful
+probe it waits one settle period, runs `tools/bench_sweep.py <out>`, then the
+inference-bench fp16/nf4 pair, and exits.
+
+Usage: python tools/relay_watch.py [sweep_out.jsonl]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+PROBE_TIMEOUT_S = 120
+PROBE_INTERVAL_S = 3600
+SETTLE_S = 120
+
+
+def probe() -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; (jax.numpy.ones(8) * 2).block_until_ready(); print('ok')"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        return out.returncode == 0 and "ok" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "SWEEP.jsonl"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    attempt = 0
+    while True:
+        attempt += 1
+        ok = probe()
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[watch] {stamp} probe {attempt}: {'ALIVE' if ok else 'wedged'}", flush=True)
+        if ok:
+            break
+        time.sleep(PROBE_INTERVAL_S)
+    time.sleep(SETTLE_S)
+    print("[watch] relay alive — running bench sweep", flush=True)
+    subprocess.run([sys.executable, os.path.join(root, "tools", "bench_sweep.py"), out_path])
+    time.sleep(SETTLE_S)
+    if not probe():
+        # the sweep may have ended because the relay re-wedged; firing more
+        # device processes at a wedged relay is what KEEPS it wedged
+        print("[watch] relay re-wedged after sweep; skipping inference benches", flush=True)
+        return
+    time.sleep(SETTLE_S)
+    for quant in ("", "nf4"):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root
+        if quant:
+            env["BENCH_INF_QUANT"] = quant
+        else:
+            env.pop("BENCH_INF_QUANT", None)  # an inherited value would mislabel the fp16 row
+        print(f"[watch] inference bench quant={quant or 'fp16'}", flush=True)
+        import json as _json
+
+        try:
+            run = subprocess.run(
+                [sys.executable, os.path.join(root, "tools", "bench_inference.py")],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            line = run.stdout.strip().splitlines()[-1] if run.stdout.strip() else ""
+            stderr_tail = (run.stderr or "").strip().splitlines()[-1:] or [""]
+        except subprocess.TimeoutExpired as exc:
+            # the child may emit its result line and then hang in backend
+            # teardown — salvage it (same guard as bench_sweep.py)
+            out = (exc.stdout or b"")
+            out = out.decode(errors="replace") if isinstance(out, bytes) else out
+            line = out.strip().splitlines()[-1] if out.strip() else ""
+            stderr_tail = ["inference-bench-timeout"]
+        rec = {"config": {"BENCH_INF_QUANT": quant or "fp16"}}
+        try:
+            rec.update(_json.loads(line))
+        except (ValueError, TypeError):
+            rec["error"] = "no-json" if not line else f"unparseable: {line[:200]}"
+            rec["stderr"] = stderr_tail[0][:200]
+        with open(out_path, "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+        print(f"[watch] -> {_json.dumps(rec)[:200]}", flush=True)
+        time.sleep(SETTLE_S)
+    print("[watch] done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
