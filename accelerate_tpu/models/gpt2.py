@@ -388,6 +388,82 @@ def gpt2_blockwise(config: GPT2Config):
     return BlockwiseModel(block_fns=fns)
 
 
+def gpt2_pipeline_parts(config: GPT2Config, params: dict, num_stages: int):
+    """Decompose GPT-2 for PIPELINE TRAINING (`Accelerator.prepare_pipeline` /
+    `make_pipeline_train_step`): returns ``(stage_fn, per_stage_params, pre,
+    post)`` where each homogeneous stage runs ``n_layer / num_stages``
+    transformer blocks, the embedding runs replicated before the pipeline and
+    ln_f + LM head after it (reference role: Megatron-LM pp>1 model
+    partitioning, `utils/megatron_lm.py`).
+
+    Tying note: the LM head starts as a copy of ``wte`` but trains UNTIED —
+    pre/post are separate parameter groups and the Megatron first/last-stage
+    embedding-gradient all-reduce is not implemented. Fine-tunes from tied
+    checkpoints start tied and may drift apart.
+    """
+    if config.n_layer % num_stages:
+        raise ValueError(
+            f"n_layer {config.n_layer} must divide into {num_stages} pipeline stages"
+        )
+    if "params" in params and "wte" not in params:
+        raise ValueError(
+            "gpt2_pipeline_parts takes the bare params tree; this looks like a "
+            "variables dict with extra collections (fp8_recipe models carry "
+            "fp8_meta state that the pipeline decomposition does not thread)."
+        )
+    if "block_0" not in params:
+        raise ValueError(
+            "gpt2_pipeline_parts needs the per-layer 'block_i' param layout; "
+            "scan_layers=True stacks layers under 'blocks' — initialize the "
+            "model with scan_layers=False for pipeline decomposition (the "
+            "GPipe schedule is itself the scan over layers)."
+        )
+    per = config.n_layer // num_stages
+
+    def pre_fn(p, input_ids):
+        s = input_ids.shape[1]
+        return (
+            p["wte"].astype(config.dtype)[input_ids]
+            + p["wpe"].astype(config.dtype)[None, :s]
+        )
+
+    def stage_fn(p, x):
+        for j in range(per):
+            x = Block(config, name=f"layer_{j}").apply({"params": p[f"layer_{j}"]}, x)
+        return x
+
+    def post_fn(p, y):
+        y = nn.LayerNorm(
+            epsilon=config.layer_norm_epsilon, dtype=jnp.float32,
+            param_dtype=config.param_dtype,
+        ).apply({"params": p["ln_f"]}, y)
+        return jnp.einsum(
+            "bse,ve->bsv", y.astype(config.dtype), p["lm_head"].astype(config.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    per_stage = [
+        {f"layer_{j}": params[f"block_{s * per + j}"] for j in range(per)}
+        for s in range(num_stages)
+    ]
+    pre_p = {"wte": params["wte"], "wpe": params["wpe"]}
+    # explicit copy: the head is its own buffer from step 0 (aliasing wte would
+    # both double-donate one buffer in the fused step and hide the untying)
+    post_p = {"ln_f": params["ln_f"], "lm_head": jnp.array(params["wte"])}
+    return stage_fn, per_stage, (pre_fn, pre_p), (post_fn, post_p)
+
+
+def pipeline_lm_loss(logits: jax.Array, input_ids: jax.Array) -> jax.Array:
+    """Per-microbatch next-token CE for `make_pipeline_train_step(loss_fn=...)`
+    (the `lm_loss_fn` contract, shifted inside the loss so the pipeline's
+    targets are just the input ids)."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1].astype(jnp.float32), input_ids[:, 1:]
+    ).mean()
+
+
 def gpt2_blockwise_state_dict(params: dict) -> dict:
     """Regroup a GPT2LMHead param tree into the blockwise layout (the tied wte
     appears in both embed and head groups, like the reference's tied-weight map)."""

@@ -261,3 +261,85 @@ class TestPipelineTraining:
             acc.make_pipeline_train_step(
                 _stage_fn, _mse, num_microbatches=M, pre_fn=_pre_fn, post_fn=_post_fn
             )
+
+
+class TestGPT2PipelineTraining:
+    """The flagship model through GPipe training: decomposition parity with
+    the monolithic module, then end-to-end training (SURVEY hard part #4 on a
+    real transformer)."""
+
+    def _setup(self, n_layer=4, stages=4):
+        from accelerate_tpu.models.gpt2 import (
+            GPT2Config,
+            GPT2LMHead,
+            gpt2_pipeline_parts,
+        )
+
+        cfg = GPT2Config.tiny(n_layer=n_layer, dtype=jnp.float32)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+        parts = gpt2_pipeline_parts(cfg, params, stages)
+        return cfg, module, params, parts
+
+    def test_forward_matches_monolithic(self):
+        """The pipelined decomposition computes exactly the full module's
+        logits (same params, same math, GPipe schedule)."""
+        cfg, module, params, (stage_fn, per_stage, pre, post) = self._setup()
+        acc = _pp_accelerator()
+        model = acc.prepare_pipeline(
+            stage_fn, per_stage, pre=pre, post=post, num_microbatches=4
+        )
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (8, 16)), jnp.int32
+        )
+        ref = module.apply({"params": params}, ids)
+        got = model(ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+    def test_trains_to_decreasing_loss(self):
+        import optax
+
+        from accelerate_tpu.models.gpt2 import pipeline_lm_loss
+
+        cfg, module, params, (stage_fn, per_stage, pre, post) = self._setup()
+        acc = _pp_accelerator()
+        model = acc.prepare_pipeline(
+            stage_fn, per_stage, pre=pre, post=post, num_microbatches=4
+        )
+        acc.prepare_optimizer(optax.adamw(1e-3), model=model)
+        step = acc.make_pipeline_train_step(
+            stage_fn, pipeline_lm_loss, num_microbatches=4,
+            pre_fn=pre[0], post_fn=post[0], max_grad_norm=1.0,
+        )
+        ids = jnp.asarray(
+            np.random.default_rng(2).integers(0, cfg.vocab_size, (8, 16)), jnp.int32
+        )
+        losses = [float(step((ids, ids))) for _ in range(10)]
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        # trunk params stage-sharded; embed/head replicated
+        assert not jax.tree.leaves(model.params["stages"])[0].sharding.is_fully_replicated
+        assert model.params["pre"]["wte"].sharding.is_fully_replicated
+
+    def test_layer_count_must_divide(self):
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, gpt2_pipeline_parts
+
+        cfg = GPT2Config.tiny(n_layer=3)
+        params = GPT2LMHead(cfg).init_params(jax.random.key(0))
+        with pytest.raises(ValueError, match="divide"):
+            gpt2_pipeline_parts(cfg, params, 4)
+
+    def test_unsupported_layouts_fail_clearly(self):
+        from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, gpt2_pipeline_parts
+
+        scan_cfg = GPT2Config.tiny(n_layer=4, scan_layers=True)
+        scan_params = GPT2LMHead(scan_cfg).init_params(jax.random.key(0))
+        with pytest.raises(ValueError, match="scan_layers"):
+            gpt2_pipeline_parts(scan_cfg, scan_params, 4)
+
+        from accelerate_tpu.ops.fp8 import DelayedScalingRecipe
+
+        fp8_cfg = GPT2Config.tiny(n_layer=4, fp8_recipe=DelayedScalingRecipe())
+        fp8_vars = GPT2LMHead(fp8_cfg).init_params(jax.random.key(0))
+        with pytest.raises(ValueError, match="fp8_meta"):
+            gpt2_pipeline_parts(fp8_cfg, fp8_vars, 4)

@@ -17,21 +17,11 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT_S = 120
 PROBE_INTERVAL_S = 3600
 SETTLE_S = 120
 
-
-def probe() -> bool:
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; (jax.numpy.ones(8) * 2).block_until_ready(); print('ok')"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-        return out.returncode == 0 and "ok" in out.stdout
-    except subprocess.TimeoutExpired:
-        return False
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_sweep import probe  # noqa: E402  (ONE wedge-detection criterion)
 
 
 def main() -> None:
@@ -90,6 +80,11 @@ def main() -> None:
             f.write(_json.dumps(rec) + "\n")
         print(f"[watch] -> {_json.dumps(rec)[:200]}", flush=True)
         time.sleep(SETTLE_S)
+        if "error" in rec and not probe():
+            # an errored run may mean the relay re-wedged mid-bench; launching
+            # the next device process would keep it wedged
+            print("[watch] relay re-wedged after errored bench; stopping", flush=True)
+            return
     print("[watch] done", flush=True)
 
 
