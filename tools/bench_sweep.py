@@ -70,6 +70,9 @@ def main() -> None:
                 return
         time.sleep(SETTLE_S)  # probe itself was a device process
         env = dict(os.environ)
+        # persistent XLA compile cache: repeated configs (winner re-run,
+        # profile pass) skip the 20-40 s compile inside a scarce hardware window
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
         env.update(overlay)
         print(f"[sweep] run {i + 1}/{len(SWEEP)}: {label}", flush=True)
         bench_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
