@@ -73,6 +73,9 @@ def main() -> None:
         # persistent XLA compile cache: repeated configs (winner re-run,
         # profile pass) skip the 20-40 s compile inside a scarce hardware window
         env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache")
+        # a previously promoted BENCH_BEST.json must NOT leak into sweep rows:
+        # each row measures exactly its labeled config
+        env["BENCH_NO_OVERLAY"] = "1"
         env.update(overlay)
         print(f"[sweep] run {i + 1}/{len(SWEEP)}: {label}", flush=True)
         bench_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")

@@ -24,6 +24,51 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from bench_sweep import probe  # noqa: E402  (ONE wedge-detection criterion)
 
 
+def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
+    """Pick the best-MFU config among the rows THIS sweep appended (from
+    ``start_offset``, so stale rounds in the append-only JSONL can't win) and
+    write it to BENCH_BEST.json, which bench.py adopts as its defaults — the
+    driver's end-of-round `python bench.py` then runs the winner automatically.
+    Only real-TPU rows qualify: the CPU fallback emits the same metric name
+    with an MFU computed against a fictitious peak."""
+    import json
+
+    best = None
+    try:
+        with open(out_path) as f:
+            f.seek(start_offset)
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                detail = rec.get("detail") or {}
+                mfu = detail.get("mfu")
+                if rec.get("error") or not mfu:
+                    continue
+                if rec.get("metric") != "gpt2_train_tokens_per_sec_per_chip":
+                    continue
+                if detail.get("platform") not in ("tpu", "axon"):
+                    continue
+                if best is None or mfu > (best.get("detail") or {}).get("mfu", 0):
+                    best = rec
+    except OSError:
+        return
+    if best is None:
+        print("[watch] no successful TPU sweep rows; nothing to promote", flush=True)
+        return
+    try:
+        with open(os.path.join(root, "BENCH_BEST.json"), "w") as f:
+            json.dump(
+                {"config": best.get("config", {}), "detail": best.get("detail")}, f, indent=2
+            )
+    except OSError as e:  # a failed promotion must not kill the bench window
+        print(f"[watch] could not write BENCH_BEST.json: {e}", flush=True)
+        return
+    print(f"[watch] promoted winner mfu={best['detail']['mfu']}: "
+          f"{json.dumps(best.get('config', {}))}", flush=True)
+
+
 def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "SWEEP.jsonl"
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -38,7 +83,9 @@ def main() -> None:
         time.sleep(PROBE_INTERVAL_S)
     time.sleep(SETTLE_S)
     print("[watch] relay alive — running bench sweep", flush=True)
+    start_offset = os.path.getsize(out_path) if os.path.exists(out_path) else 0
     subprocess.run([sys.executable, os.path.join(root, "tools", "bench_sweep.py"), out_path])
+    _promote_winner(out_path, root, start_offset)
     time.sleep(SETTLE_S)
     if not probe():
         # the sweep may have ended because the relay re-wedged; firing more
