@@ -141,7 +141,9 @@ def attention(
         # XLA path regardless of the requested implementation
         implementation = "xla"
     if implementation == "auto":
-        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        from ..utils.environment import on_tpu_platform
+
+        on_tpu = on_tpu_platform()
         implementation = "flash" if (on_tpu and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]) else "xla"
         if window is not None and implementation == "flash":
             # the band grid needs a block divisor of seq; un-tileable lengths

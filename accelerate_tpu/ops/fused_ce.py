@@ -33,7 +33,9 @@ NEG_INF = -1e30
 
 
 def _on_tpu() -> bool:
-    return jax.devices()[0].platform in ("tpu", "axon")
+    from ..utils.environment import on_tpu_platform
+
+    return on_tpu_platform()
 
 
 # --------------------------------------------------------------------- forward

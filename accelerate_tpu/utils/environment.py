@@ -14,6 +14,14 @@ import os
 from typing import Any
 
 
+def on_tpu_platform() -> bool:
+    """True on real TPU or the axon tunnel — THE platform probe (kernels pick
+    compiled-vs-interpret and dispatchers pick flash-vs-xla off this)."""
+    import jax
+
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
 def str_to_bool(value: str) -> int:
     """Convert a truthy/falsy string to 1/0 (raises on anything else)."""
     value = value.lower()

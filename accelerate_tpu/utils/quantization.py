@@ -91,7 +91,9 @@ class QuantizedTensor:
     `shape`/`bits`/`quant_type`/`compute_dtype` ride in the static aux data.
     """
 
-    __slots__ = ("data", "scales", "shape", "bits", "quant_type", "compute_dtype")
+    # _plane_pack: host-side kernel-layout cache (ops/nf4_matmul.plane_pack);
+    # never flattened into the pytree
+    __slots__ = ("data", "scales", "shape", "bits", "quant_type", "compute_dtype", "_plane_pack")
 
     def __init__(self, data, scales, shape, bits, quant_type, compute_dtype):
         self.data = data
@@ -100,6 +102,7 @@ class QuantizedTensor:
         self.bits = bits
         self.quant_type = quant_type
         self.compute_dtype = compute_dtype
+        self._plane_pack = None
 
     def tree_flatten(self):
         return (self.data, self.scales), (self.shape, self.bits, self.quant_type, self.compute_dtype)
