@@ -12,6 +12,7 @@ Usage: python tools/relay_watch.py [sweep_out.jsonl]
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -24,6 +25,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from bench_sweep import probe  # noqa: E402  (ONE wedge-detection criterion)
 
 
+def _run_salvaging(cmd: list[str], env: dict, timeout: int = 1800) -> tuple[str, str]:
+    """Run a bench child, salvaging stdout if it emits its result and then
+    hangs in backend teardown (the documented relay failure mode). Returns
+    (stdout_text, stderr_tail) — ONE implementation of the pattern for every
+    bench invocation in this file."""
+    try:
+        run = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=timeout)
+        stderr = (run.stderr or "").strip().splitlines()
+        return run.stdout or "", (stderr[-1] if stderr else "")
+    except subprocess.TimeoutExpired as exc:
+        out = exc.stdout or b""
+        out = out.decode(errors="replace") if isinstance(out, bytes) else out
+        return out, "bench-timeout"
+
+
 def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
     """Pick the best-MFU config among the rows THIS sweep appended (from
     ``start_offset``, so stale rounds in the append-only JSONL can't win) and
@@ -31,8 +47,6 @@ def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
     driver's end-of-round `python bench.py` then runs the winner automatically.
     Only real-TPU rows qualify: the CPU fallback emits the same metric name
     with an MFU computed against a fictitious peak."""
-    import json
-
     best = None
     try:
         with open(out_path) as f:
@@ -101,37 +115,45 @@ def main() -> None:
         else:
             env.pop("BENCH_INF_QUANT", None)  # an inherited value would mislabel the fp16 row
         print(f"[watch] inference bench quant={quant or 'fp16'}", flush=True)
-        import json as _json
-
-        try:
-            run = subprocess.run(
-                [sys.executable, os.path.join(root, "tools", "bench_inference.py")],
-                env=env, capture_output=True, text=True, timeout=1800,
-            )
-            line = run.stdout.strip().splitlines()[-1] if run.stdout.strip() else ""
-            stderr_tail = (run.stderr or "").strip().splitlines()[-1:] or [""]
-        except subprocess.TimeoutExpired as exc:
-            # the child may emit its result line and then hang in backend
-            # teardown — salvage it (same guard as bench_sweep.py)
-            out = (exc.stdout or b"")
-            out = out.decode(errors="replace") if isinstance(out, bytes) else out
-            line = out.strip().splitlines()[-1] if out.strip() else ""
-            stderr_tail = ["inference-bench-timeout"]
+        stdout, stderr_tail = _run_salvaging(
+            [sys.executable, os.path.join(root, "tools", "bench_inference.py")], env
+        )
+        line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
         rec = {"config": {"BENCH_INF_QUANT": quant or "fp16"}}
         try:
-            rec.update(_json.loads(line))
+            rec.update(json.loads(line))
         except (ValueError, TypeError):
             rec["error"] = "no-json" if not line else f"unparseable: {line[:200]}"
-            rec["stderr"] = stderr_tail[0][:200]
+            rec["stderr"] = stderr_tail[:200]
         with open(out_path, "a") as f:
-            f.write(_json.dumps(rec) + "\n")
-        print(f"[watch] -> {_json.dumps(rec)[:200]}", flush=True)
+            f.write(json.dumps(rec) + "\n")
+        print(f"[watch] -> {json.dumps(rec)[:200]}", flush=True)
         time.sleep(SETTLE_S)
         if "error" in rec and not probe():
             # an errored run may mean the relay re-wedged mid-bench; launching
             # the next device process would keep it wedged
             print("[watch] relay re-wedged after errored bench; stopping", flush=True)
             return
+    # nf4 kernel-vs-XLA micro-timings: the go/no-go data for wiring the fused
+    # dequant-matmul into the decode loop (docs/PERF_NOTES.md round-4 queue)
+    print("[watch] nf4 kernel microbench", flush=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root
+    stdout, stderr_tail = _run_salvaging(
+        [sys.executable, os.path.join(root, "tools", "bench_nf4_kernel.py")], env
+    )
+    rows = []
+    for ln in stdout.strip().splitlines():
+        try:
+            rows.append(json.loads(ln))  # drops lines truncated by a mid-print kill
+        except ValueError:
+            continue
+    if not rows:
+        rows = [{"metric": "nf4_matmul_us", "error": "no-json", "stderr": stderr_tail[:200]}]
+    with open(out_path, "a") as f:
+        for rec in rows:
+            f.write(json.dumps(rec) + "\n")
+    print(f"[watch] nf4 microbench rows: {len(rows)}", flush=True)
     print("[watch] done", flush=True)
 
 
