@@ -1,0 +1,154 @@
+"""The hardware-window automation (bench sweep / relay watcher / winner
+promotion) decides what the driver's end-of-round bench measures — the logic
+is test-pinned so an unattended window can't silently record garbage."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.fixture()
+def relay_watch():
+    import importlib
+
+    import relay_watch as rw
+
+    return importlib.reload(rw)
+
+
+class TestPromoteWinner:
+    def _write(self, path, rows):
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def _row(self, mfu, platform="axon", config=None, **kw):
+        return {
+            "config": config or {},
+            "metric": "gpt2_train_tokens_per_sec_per_chip",
+            "value": 1,
+            "detail": {"mfu": mfu, "platform": platform},
+            **kw,
+        }
+
+    def test_picks_best_tpu_mfu(self, tmp_path, relay_watch):
+        p = tmp_path / "s.jsonl"
+        self._write(p, [
+            self._row(0.2, config={"A": "1"}),
+            self._row(0.3, config={"B": "1"}),
+            self._row(0.25, config={"C": "1"}),
+        ])
+        relay_watch._promote_winner(str(p), str(tmp_path), 0)
+        best = json.load(open(tmp_path / "BENCH_BEST.json"))
+        assert best["config"] == {"B": "1"}
+
+    def test_ignores_cpu_error_and_stale_rows(self, tmp_path, relay_watch):
+        p = tmp_path / "s.jsonl"
+        stale = [self._row(0.9, config={"STALE": "1"})]
+        self._write(p, stale)
+        offset = os.path.getsize(p)
+        with open(p, "a") as f:
+            f.write(json.dumps(self._row(0.8, platform="cpu", config={"CPU": "1"})) + "\n")
+            f.write(json.dumps(self._row(0.7, config={"ERR": "1"}, error="x")) + "\n")
+            f.write(json.dumps(self._row(0.3, config={"GOOD": "1"})) + "\n")
+        relay_watch._promote_winner(str(p), str(tmp_path), offset)
+        best = json.load(open(tmp_path / "BENCH_BEST.json"))
+        assert best["config"] == {"GOOD": "1"}
+
+    def test_no_tpu_rows_no_file(self, tmp_path, relay_watch):
+        p = tmp_path / "s.jsonl"
+        self._write(p, [self._row(0.5, platform="cpu")])
+        relay_watch._promote_winner(str(p), str(tmp_path), 0)
+        assert not (tmp_path / "BENCH_BEST.json").exists()
+
+
+class TestRunSalvaging:
+    def test_captures_stdout_and_stderr_tail(self, relay_watch):
+        out, err = relay_watch._run_salvaging(
+            [sys.executable, "-c",
+             "import sys; print('{\"ok\": 1}'); sys.stderr.write('warn\\nboom\\n'); sys.exit(2)"],
+            dict(os.environ),
+        )
+        assert '{"ok": 1}' in out
+        assert err == "boom"
+
+    def test_timeout_salvages_partial_stdout(self, relay_watch):
+        out, err = relay_watch._run_salvaging(
+            [sys.executable, "-u", "-c",
+             "import time; print('{\"saved\": 1}', flush=True); time.sleep(60)"],
+            dict(os.environ), timeout=3,
+        )
+        assert '{"saved": 1}' in out
+        assert err == "bench-timeout"
+
+
+class TestBenchOverlay:
+    @pytest.fixture(autouse=True)
+    def _stash_real_winner(self):
+        """A genuine promoted BENCH_BEST.json (the artifact the automation
+        exists to produce) must survive the tests unharmed."""
+        best = REPO / "BENCH_BEST.json"
+        backup = best.read_bytes() if best.exists() else None
+        try:
+            if best.exists():
+                best.unlink()
+            yield
+        finally:
+            if best.exists():
+                best.unlink()
+            if backup is not None:
+                best.write_bytes(backup)
+
+    def _bench(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("bench_mod", REPO / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_overlay_applied_and_env_wins(self, monkeypatch):
+        best = REPO / "BENCH_BEST.json"
+        best.write_text(json.dumps({"config": {"BENCH_MODEL": "medium", "BENCH_FUSED_CE": "2"}}))
+        try:
+            monkeypatch.delenv("BENCH_MODEL", raising=False)
+            monkeypatch.setenv("BENCH_FUSED_CE", "0")  # explicit env beats overlay
+            monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
+            self._bench()._apply_best_overlay()
+            assert os.environ["BENCH_MODEL"] == "medium"
+            assert os.environ["BENCH_FUSED_CE"] == "0"
+        finally:
+            best.unlink()
+            os.environ.pop("BENCH_MODEL", None)
+
+    def test_kill_switch(self, monkeypatch):
+        best = REPO / "BENCH_BEST.json"
+        best.write_text(json.dumps({"config": {"BENCH_MODEL": "medium"}}))
+        try:
+            monkeypatch.delenv("BENCH_MODEL", raising=False)
+            monkeypatch.setenv("BENCH_NO_OVERLAY", "1")
+            self._bench()._apply_best_overlay()
+            assert "BENCH_MODEL" not in os.environ
+        finally:
+            best.unlink()
+
+    def test_non_bench_keys_ignored(self, monkeypatch):
+        best = REPO / "BENCH_BEST.json"
+        best.write_text(json.dumps({"config": {"PATH": "/evil", "BENCH_MODEL": "medium"}}))
+        try:
+            monkeypatch.delenv("BENCH_MODEL", raising=False)
+            monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
+            old_path = os.environ["PATH"]
+            self._bench()._apply_best_overlay()
+            assert os.environ["PATH"] == old_path
+            assert os.environ["BENCH_MODEL"] == "medium"
+        finally:
+            best.unlink()
+            os.environ.pop("BENCH_MODEL", None)
