@@ -139,7 +139,9 @@ def _batch_norm_train(mod, params, x):
     unbiased = var * (n / max(n - 1, 1))
     if mod.momentum is None:
         # torch semantics: cumulative moving average, factor 1/num_batches
-        nbt = params.get("num_batches_tracked", jnp.zeros((), jnp.int64)) + 1
+        # int32: JAX truncates int64 without x64 mode anyway (torch stores this
+        # counter as int64, but 2^31 batches is out of reach)
+        nbt = params.get("num_batches_tracked", jnp.zeros((), jnp.int32)) + 1
         m = 1.0 / nbt.astype(jnp.float32)
     else:
         m = mod.momentum

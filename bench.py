@@ -149,7 +149,9 @@ def _apply_best_overlay() -> None:
     plain `python bench.py` run without hand-editing defaults."""
     if os.environ.get("BENCH_NO_OVERLAY") == "1":
         return  # sweep children must measure EXACTLY their labeled config
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BEST.json")
+    path = os.environ.get("BENCH_BEST_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BEST.json"
+    )
     if not os.path.exists(path):
         return
     try:

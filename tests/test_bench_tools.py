@@ -91,20 +91,11 @@ class TestRunSalvaging:
 
 class TestBenchOverlay:
     @pytest.fixture(autouse=True)
-    def _stash_real_winner(self):
-        """A genuine promoted BENCH_BEST.json (the artifact the automation
-        exists to produce) must survive the tests unharmed."""
-        best = REPO / "BENCH_BEST.json"
-        backup = best.read_bytes() if best.exists() else None
-        try:
-            if best.exists():
-                best.unlink()
-            yield
-        finally:
-            if best.exists():
-                best.unlink()
-            if backup is not None:
-                best.write_bytes(backup)
+    def _clean_overlay_env(self):
+        """_apply_best_overlay writes os.environ directly (outside monkeypatch's
+        bookkeeping) — scrub the keys it can set."""
+        yield
+        os.environ.pop("BENCH_MODEL", None)
 
     def _bench(self):
         import importlib.util
@@ -114,41 +105,32 @@ class TestBenchOverlay:
         spec.loader.exec_module(mod)
         return mod
 
-    def test_overlay_applied_and_env_wins(self, monkeypatch):
-        best = REPO / "BENCH_BEST.json"
-        best.write_text(json.dumps({"config": {"BENCH_MODEL": "medium", "BENCH_FUSED_CE": "2"}}))
-        try:
-            monkeypatch.delenv("BENCH_MODEL", raising=False)
-            monkeypatch.setenv("BENCH_FUSED_CE", "0")  # explicit env beats overlay
-            monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
-            self._bench()._apply_best_overlay()
-            assert os.environ["BENCH_MODEL"] == "medium"
-            assert os.environ["BENCH_FUSED_CE"] == "0"
-        finally:
-            best.unlink()
-            os.environ.pop("BENCH_MODEL", None)
+    def _write_best(self, tmp_path, monkeypatch, config):
+        """Point the overlay at a tmp file (BENCH_BEST_PATH) — tests must never
+        touch a real promoted winner at the repo root."""
+        best = tmp_path / "BENCH_BEST.json"
+        best.write_text(json.dumps({"config": config}))
+        monkeypatch.setenv("BENCH_BEST_PATH", str(best))
+        monkeypatch.delenv("BENCH_MODEL", raising=False)
 
-    def test_kill_switch(self, monkeypatch):
-        best = REPO / "BENCH_BEST.json"
-        best.write_text(json.dumps({"config": {"BENCH_MODEL": "medium"}}))
-        try:
-            monkeypatch.delenv("BENCH_MODEL", raising=False)
-            monkeypatch.setenv("BENCH_NO_OVERLAY", "1")
-            self._bench()._apply_best_overlay()
-            assert "BENCH_MODEL" not in os.environ
-        finally:
-            best.unlink()
+    def test_overlay_applied_and_env_wins(self, tmp_path, monkeypatch):
+        self._write_best(tmp_path, monkeypatch, {"BENCH_MODEL": "medium", "BENCH_FUSED_CE": "2"})
+        monkeypatch.setenv("BENCH_FUSED_CE", "0")  # explicit env beats overlay
+        monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
+        self._bench()._apply_best_overlay()
+        assert os.environ["BENCH_MODEL"] == "medium"
+        assert os.environ["BENCH_FUSED_CE"] == "0"
 
-    def test_non_bench_keys_ignored(self, monkeypatch):
-        best = REPO / "BENCH_BEST.json"
-        best.write_text(json.dumps({"config": {"PATH": "/evil", "BENCH_MODEL": "medium"}}))
-        try:
-            monkeypatch.delenv("BENCH_MODEL", raising=False)
-            monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
-            old_path = os.environ["PATH"]
-            self._bench()._apply_best_overlay()
-            assert os.environ["PATH"] == old_path
-            assert os.environ["BENCH_MODEL"] == "medium"
-        finally:
-            best.unlink()
-            os.environ.pop("BENCH_MODEL", None)
+    def test_kill_switch(self, tmp_path, monkeypatch):
+        self._write_best(tmp_path, monkeypatch, {"BENCH_MODEL": "medium"})
+        monkeypatch.setenv("BENCH_NO_OVERLAY", "1")
+        self._bench()._apply_best_overlay()
+        assert "BENCH_MODEL" not in os.environ
+
+    def test_non_bench_keys_ignored(self, tmp_path, monkeypatch):
+        self._write_best(tmp_path, monkeypatch, {"PATH": "/evil", "BENCH_MODEL": "medium"})
+        monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
+        old_path = os.environ["PATH"]
+        self._bench()._apply_best_overlay()
+        assert os.environ["PATH"] == old_path
+        assert os.environ["BENCH_MODEL"] == "medium"

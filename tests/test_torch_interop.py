@@ -173,7 +173,7 @@ class TestTrainingMode:
         (lj, new_state), grads = jax.value_and_grad(loss_j, has_aux=True)(
             variables["params"], {"torch_state": variables["torch_state"]}
         )
-        np.testing.assert_allclose(float(lj), float(loss_t), rtol=1e-5)
+        np.testing.assert_allclose(float(lj), float(loss_t.detach()), rtol=1e-5)
         for name, g in torch_grads.items():
             np.testing.assert_allclose(np.asarray(grads[name]), g, atol=1e-5, rtol=1e-4)
         new_buffers = new_state["torch_state"]["buffers"]
