@@ -127,6 +127,28 @@ class TestBenchOverlay:
         self._bench()._apply_best_overlay()
         assert "BENCH_MODEL" not in os.environ
 
+    def test_default_sibling_path_discovery(self, tmp_path, monkeypatch):
+        """The branch every real `python bench.py` run takes: a BENCH_BEST.json
+        sitting next to bench.py — exercised on a tmp COPY so a real promoted
+        winner is never touched."""
+        import shutil
+
+        bench_copy = tmp_path / "bench.py"
+        shutil.copy(REPO / "bench.py", bench_copy)
+        (tmp_path / "BENCH_BEST.json").write_text(
+            json.dumps({"config": {"BENCH_MODEL": "medium"}})
+        )
+        monkeypatch.delenv("BENCH_BEST_PATH", raising=False)
+        monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)
+        monkeypatch.delenv("BENCH_MODEL", raising=False)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("bench_copy_mod", bench_copy)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod._apply_best_overlay()
+        assert os.environ["BENCH_MODEL"] == "medium"
+
     def test_non_bench_keys_ignored(self, tmp_path, monkeypatch):
         self._write_best(tmp_path, monkeypatch, {"PATH": "/evil", "BENCH_MODEL": "medium"})
         monkeypatch.delenv("BENCH_NO_OVERLAY", raising=False)

@@ -71,5 +71,6 @@ def test_scripts_stay_in_sync_with_common_base():
         assert "_common" in src, f"{name} does not use the shared _common base"
         assert "Accelerator(" in src, f"{name} does not construct an Accelerator"
         assert (
-            "make_train_step" in src or "backward(" in src or "make_local_train_step" in src
+            "make_train_step" in src or "backward(" in src
+            or "make_local_train_step" in src or "make_pipeline_train_step" in src
         ), f"{name} does not train through the framework API"

@@ -37,6 +37,7 @@ EXCLUDED = {
     "profiler.py": "profiling wraps the loop; not a training feature",
     "schedule_free.py": "optimizer-family swap, not a loop feature",
     "sliding_window_long_context.py": "model-architecture feature",
+    "pipeline_parallel_training.py": "stage-mesh GPipe training is topology-specific",
     "tensor_parallel_gpt_pretraining.py": "TP mesh pretraining is topology-specific",
 }
 
