@@ -262,6 +262,41 @@ class TestPipelineTraining:
                 _stage_fn, _mse, num_microbatches=M, pre_fn=_pre_fn, post_fn=_post_fn
             )
 
+    def test_dataloader_sync_forces_epoch_end_boundary(self):
+        """PP x grad accumulation x dataloader sync: an ODD number of batches
+        with accumulation 2 must still apply the trailing gradient at epoch end
+        (GradientState.end_of_dataloader forces the boundary), and the next
+        epoch re-arms cleanly."""
+        import optax
+
+        from accelerate_tpu.data_loader import DataLoaderShard
+
+        acc = _pp_accelerator(gradient_accumulation_steps=2)
+        model, opt, _ = self._setup(acc)
+        step = acc.make_pipeline_train_step(
+            _stage_fn, _mse, num_microbatches=M, pre_fn=_pre_fn, post_fn=_post_fn
+        )
+        data = self._data(n_batches=3)  # odd: last boundary comes from epoch end
+        batches = [{"x": x, "t": t} for x, t in data]
+        dl = acc.prepare(DataLoaderShard(batches))
+        before = jax.device_get(model.params)
+        updates = 0
+        for epoch in range(2):
+            for b in dl:
+                step((b["x"], b["t"]))
+                if acc.gradient_state.sync_gradients:
+                    updates += 1
+        # 3 batches/epoch at k=2: boundaries at batch 2 (count) and batch 3
+        # (end_of_dataloader) -> 2 updates per epoch
+        assert updates == 4, updates
+        after = jax.device_get(model.params)
+        moved = any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(after), jax.tree.leaves(before))
+        )
+        assert moved
+        assert opt.num_updates == 4
+
 
 class TestGPT2PipelineTraining:
     """The flagship model through GPipe training: decomposition parity with
