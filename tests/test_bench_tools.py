@@ -156,3 +156,42 @@ class TestBenchOverlay:
         self._bench()._apply_best_overlay()
         assert os.environ["PATH"] == old_path
         assert os.environ["BENCH_MODEL"] == "medium"
+
+
+class TestWindowResume:
+    def test_promote_never_demotes(self, tmp_path, relay_watch):
+        import json as _j
+
+        p = tmp_path / "s.jsonl"
+        (tmp_path / "BENCH_BEST.json").write_text(
+            _j.dumps({"config": {"OLD": "1"}, "detail": {"mfu": 0.5}})
+        )
+        with open(p, "w") as f:
+            f.write(_j.dumps({
+                "config": {"NEW": "1"},
+                "metric": "gpt2_train_tokens_per_sec_per_chip",
+                "value": 1,
+                "detail": {"mfu": 0.4, "platform": "axon"},
+            }) + "\n")
+        relay_watch._promote_winner(str(p), str(tmp_path), 0)
+        best = _j.load(open(tmp_path / "BENCH_BEST.json"))
+        assert best["config"] == {"OLD": "1"}  # degraded retry can't demote
+
+    def test_run_window_skips_completed_sweep(self, tmp_path, relay_watch, monkeypatch):
+        import types
+
+        monkeypatch.setattr(relay_watch, "SETTLE_S", 0)
+        calls = []
+        monkeypatch.setattr(
+            relay_watch.subprocess, "run",
+            lambda cmd, **kw: calls.append(cmd) or types.SimpleNamespace(
+                stdout="", stderr="", returncode=0
+            ),
+        )
+        monkeypatch.setattr(relay_watch, "probe", lambda: False)  # re-wedge immediately
+        done = {"sweep"}
+        ok = relay_watch._run_window(str(tmp_path / "s.jsonl"), str(tmp_path), done)
+        # sweep skipped (no bench_sweep invocation); the window proceeded to
+        # the inference phase, whose first errored run + dead probe pauses it
+        assert not any("bench_sweep" in " ".join(map(str, c)) for c in calls)
+        assert ok is False

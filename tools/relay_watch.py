@@ -1,11 +1,14 @@
-"""Relay watcher: probe hourly (the PERF_NOTES wedge-safe cadence) and launch
-the bench sweep the moment the relay answers.
+"""Relay watcher: probe hourly (the PERF_NOTES wedge-safe cadence) and run the
+bench program when the relay answers.
 
 Runs as the SINGLE device-touching process while the relay is wedged — a
 timed-out probe is itself a mid-op kill, so more frequent probing keeps the
-relay wedged (docs/PERF_NOTES.md round-3 addendum). On the first successful
-probe it waits one settle period, runs `tools/bench_sweep.py <out>`, then the
-inference-bench fp16/nf4 pair, and exits.
+relay wedged (docs/PERF_NOTES.md round-3 addendum). On a successful probe it
+runs one hardware window: sweep -> winner promotion -> inference fp16/nf4
+pair -> nf4 kernel micro. Completed phases are remembered, so a window lost
+to a mid-program re-wedge resumes at the NEXT unfinished phase in a later
+window (up to MAX_WINDOWS attempts); the process exits once the full program
+has completed, or after the attempt cap.
 
 Usage: python tools/relay_watch.py [sweep_out.jsonl]
 """
@@ -20,6 +23,7 @@ import time
 
 PROBE_INTERVAL_S = 3600
 SETTLE_S = 120
+MAX_WINDOWS = 3  # re-wedge retry cap: a persistently flaky relay stops here
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from bench_sweep import probe  # noqa: E402  (ONE wedge-detection criterion)
@@ -71,8 +75,22 @@ def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
     if best is None:
         print("[watch] no successful TPU sweep rows; nothing to promote", flush=True)
         return
+    best_path = os.path.join(root, "BENCH_BEST.json")
     try:
-        with open(os.path.join(root, "BENCH_BEST.json"), "w") as f:
+        with open(best_path) as f:
+            incumbent_mfu = (json.load(f).get("detail") or {}).get("mfu", 0)
+    except (OSError, ValueError):
+        incumbent_mfu = 0
+    if best["detail"]["mfu"] <= incumbent_mfu:
+        # never demote: a degraded retry window must not replace a better
+        # previously promoted config
+        print(
+            f"[watch] keeping incumbent winner mfu={incumbent_mfu} "
+            f"(this window's best: {best['detail']['mfu']})", flush=True,
+        )
+        return
+    try:
+        with open(best_path, "w") as f:
             json.dump(
                 {"config": best.get("config", {}), "detail": best.get("detail")}, f, indent=2
             )
@@ -86,28 +104,49 @@ def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
 def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "SWEEP.jsonl"
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    attempt = 0
-    while True:
+    done: set[str] = set()  # completed phases survive lost windows
+    attempt = windows = 0
+    while windows < MAX_WINDOWS:
         attempt += 1
         ok = probe()
         stamp = time.strftime("%H:%M:%S")
         print(f"[watch] {stamp} probe {attempt}: {'ALIVE' if ok else 'wedged'}", flush=True)
         if ok:
-            break
+            windows += 1
+            if _run_window(out_path, root, done):
+                return
+            # window lost to a re-wedge: resume at the next unfinished phase
+            # in a later window (hourly probe cadence)
+            print(f"[watch] window {windows} lost; phases done: {sorted(done)}", flush=True)
         time.sleep(PROBE_INTERVAL_S)
+    print(f"[watch] giving up after {MAX_WINDOWS} lost windows", flush=True)
+
+
+def _run_window(out_path: str, root: str, done: set[str]) -> bool:
+    """One hardware window, resuming at the first phase not in ``done``:
+    sweep -> promote -> inference pair -> nf4 micro. Returns True when the
+    full program has completed, False when the relay re-wedged partway
+    (partial results are already on disk either way)."""
     time.sleep(SETTLE_S)
-    print("[watch] relay alive — running bench sweep", flush=True)
-    start_offset = os.path.getsize(out_path) if os.path.exists(out_path) else 0
-    subprocess.run([sys.executable, os.path.join(root, "tools", "bench_sweep.py"), out_path])
-    _promote_winner(out_path, root, start_offset)
-    time.sleep(SETTLE_S)
-    if not probe():
-        # the sweep may have ended because the relay re-wedged; firing more
-        # device processes at a wedged relay is what KEEPS it wedged
-        print("[watch] relay re-wedged after sweep; skipping inference benches", flush=True)
-        return
+    if "sweep" not in done:
+        print("[watch] relay alive — running bench sweep", flush=True)
+        start_offset = os.path.getsize(out_path) if os.path.exists(out_path) else 0
+        subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "bench_sweep.py"), out_path]
+        )
+        _promote_winner(out_path, root, start_offset)
+        done.add("sweep")
+        time.sleep(SETTLE_S)
+        if not probe():
+            # the sweep may have ended because the relay re-wedged; firing more
+            # device processes at a wedged relay is what KEEPS it wedged
+            print("[watch] relay re-wedged after sweep; pausing window", flush=True)
+            return False
     time.sleep(SETTLE_S)
     for quant in ("", "nf4"):
+        phase = f"inf_{quant or 'fp16'}"
+        if phase in done:
+            continue
         env = dict(os.environ)
         env["PYTHONPATH"] = root
         if quant:
@@ -132,8 +171,9 @@ def main() -> None:
         if "error" in rec and not probe():
             # an errored run may mean the relay re-wedged mid-bench; launching
             # the next device process would keep it wedged
-            print("[watch] relay re-wedged after errored bench; stopping", flush=True)
-            return
+            print("[watch] relay re-wedged after errored bench; pausing window", flush=True)
+            return False
+        done.add(phase)
     # nf4 kernel-vs-XLA micro-timings: the go/no-go data for wiring the fused
     # dequant-matmul into the decode loop (docs/PERF_NOTES.md round-4 queue)
     print("[watch] nf4 kernel microbench", flush=True)
@@ -153,8 +193,10 @@ def main() -> None:
     with open(out_path, "a") as f:
         for rec in rows:
             f.write(json.dumps(rec) + "\n")
+    done.add("nf4_micro")
     print(f"[watch] nf4 microbench rows: {len(rows)}", flush=True)
     print("[watch] done", flush=True)
+    return True
 
 
 if __name__ == "__main__":
