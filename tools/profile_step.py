@@ -86,7 +86,11 @@ def summarize(log_dir: str) -> dict:
     paths = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"), recursive=True)
     if not paths:
         return {"error": f"no xplane under {log_dir}"}
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    try:
+        from tensorboard_plugin_profile.convert import raw_to_tool_data
+    except Exception as e:  # plugin/pywrap mismatch (seen on the CPU path):
+        # the trace is still on disk for offline analysis
+        return {"xplane": paths[-1], "parse_error": repr(e)}
 
     out: dict = {"xplane": paths[-1]}
     try:
