@@ -76,16 +76,14 @@ class GPT2Config:
 
 def _dense(cfg: GPT2Config, features: int, name: str) -> nn.Module:
     """Block projection factory: plain Dense, or Fp8Dense when the config
-    carries an fp8 recipe (reference `transformer_engine.py:26-82`
-    convert_model role — same param names, so checkpoints stay compatible)."""
-    if cfg.fp8_recipe is not None:
-        from ..ops.fp8 import Fp8Dense
+    carries an fp8 recipe (ops/fp8.convert_dense_to_fp8 — the reference
+    `transformer_engine.py:26-82` convert_model role; same param names, so
+    checkpoints stay compatible)."""
+    from ..ops.fp8 import convert_dense_to_fp8
 
-        return Fp8Dense(
-            features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            recipe=cfg.fp8_recipe, name=name,
-        )
-    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+    return convert_dense_to_fp8(cfg.fp8_recipe)(
+        features, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+    )
 
 
 class SelfAttention(nn.Module):

@@ -42,6 +42,9 @@ class LlamaConfig:
     remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
     sliding_window: int | None = None  # Mistral-class: query i sees keys in (i-W, i]
+    # fp8 projections (reference TE convert_model role; see models/gpt2._dense):
+    # a DelayedScalingRecipe switches every block projection to ops/fp8.Fp8Dense
+    fp8_recipe: Any = None
 
     @classmethod
     def llama2_7b(cls, **kw) -> "LlamaConfig":
@@ -57,6 +60,17 @@ class LlamaConfig:
     def tiny(cls, **kw) -> "LlamaConfig":
         return cls(**{**dict(vocab_size=256, max_position_embeddings=128, hidden_size=64,
                              intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2), **kw})
+
+
+def _dense(cfg: LlamaConfig, features: int, name: str) -> nn.Module:
+    """Block projection factory: bias-free Dense, or Fp8Dense when the config
+    carries an fp8 recipe (ops/fp8.convert_dense_to_fp8 — ONE switch shared
+    with gpt2; same param names, so checkpoints stay compatible)."""
+    from ..ops.fp8 import convert_dense_to_fp8
+
+    return convert_dense_to_fp8(cfg.fp8_recipe)(
+        features, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+    )
 
 
 class RMSNorm(nn.Module):
@@ -95,8 +109,7 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.num_heads
-        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype,
-                                         param_dtype=cfg.param_dtype, name=name)
+        dense = lambda n, name: _dense(cfg, n, name)
         q = dense(cfg.num_heads * head_dim, "q_proj")(x).reshape(b, s, cfg.num_heads, head_dim)
         k = dense(cfg.num_kv_heads * head_dim, "k_proj")(x).reshape(b, s, cfg.num_kv_heads, head_dim)
         v = dense(cfg.num_kv_heads * head_dim, "v_proj")(x).reshape(b, s, cfg.num_kv_heads, head_dim)
@@ -163,8 +176,7 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
-        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype,
-                                         param_dtype=cfg.param_dtype, name=name)
+        dense = lambda n, name: _dense(cfg, n, name)
         gate = dense(cfg.intermediate_size, "gate_proj")(x)
         up = dense(cfg.intermediate_size, "up_proj")(x)
         return dense(cfg.hidden_size, "down_proj")(jax.nn.silu(gate) * up)
@@ -217,7 +229,12 @@ class LlamaForCausalLM(nn.Module):
                           preferred_element_type=jnp.float32)
 
     def init_params(self, rng: jax.Array, batch: int = 2, seq: int = 16) -> Any:
-        return self.init(rng, jnp.zeros((batch, seq), dtype=jnp.int32))["params"]
+        variables = self.init(rng, jnp.zeros((batch, seq), dtype=jnp.int32))
+        if len(variables) > 1:
+            # mutable collections (fp8_meta scaling state) ride along; prepare()
+            # splits them into PreparedModel.extra_state
+            return dict(variables)
+        return variables["params"]
 
 
 def llama_sharding_rules(config: LlamaConfig | None = None) -> ShardingRules:

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, NamedTuple
 
+import flax
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -302,8 +303,17 @@ class Fp8Dense(nn.Module):
             "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
         )
         meta_init = lambda: new_meta(r.amax_history_len)  # noqa: E731
-        x_meta = self.variable("fp8_meta", "input", meta_init)
-        k_meta = self.variable("fp8_meta", "kernel", meta_init)
+        try:
+            x_meta = self.variable("fp8_meta", "input", meta_init)
+            k_meta = self.variable("fp8_meta", "kernel", meta_init)
+        except flax.errors.ScopeCollectionNotFound as e:
+            raise ValueError(
+                "Fp8Dense needs its delayed-scaling state: pass the 'fp8_meta' "
+                "collection in variables (init_params returns it; "
+                "Accelerator.prepare threads it as extra_state). Paths that "
+                "don't thread it — e.g. models/generation.py decode — cannot "
+                "run fp8 models; use the dense or weight-quantized model there."
+            ) from e
 
         kernel = kernel.astype(self.dtype)
         xc = x.astype(self.dtype)
@@ -316,7 +326,9 @@ class Fp8Dense(nn.Module):
             k_meta.value["scale"],
             r.fp8_format.upper() == "E4M3",
         ).reshape(*lead, self.features)
-        if not self.is_initializing():
+        if not self.is_initializing() and self.is_mutable_collection("fp8_meta"):
+            # read-only applies (eval without mutable=['fp8_meta']) keep the
+            # existing scales instead of crashing on the assignment
             x_meta.value = _update_meta(x_meta.value, xc, E4M3_MAX, r.margin)
             k_meta.value = _update_meta(k_meta.value, kernel, E4M3_MAX, r.margin)
         if self.use_bias:

@@ -277,3 +277,40 @@ class TestNativeFp8:
         # per-layer state is stacked on a leading layer axis of size n_layer
         leaf = jax.tree.leaves(variables["fp8_meta"])[0]
         assert leaf.shape[0] == cfg.n_layer
+
+    def test_llama_fp8_forward_and_grads(self):
+        """Llama with fp8 projections: same param names as the dense model,
+        finite forward and grads through the fp8_meta threading."""
+        from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32,
+                               fp8_recipe=DelayedScalingRecipe(amax_history_len=4))
+        module = LlamaForCausalLM(cfg)
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        variables = module.init(jax.random.key(0), ids)
+        assert "fp8_meta" in variables
+        dense_cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        dense_vars = LlamaForCausalLM(dense_cfg).init(jax.random.key(0), ids)
+        # identical param TREE structure (checkpoint compatibility)
+        assert jax.tree.structure(variables["params"]) == jax.tree.structure(dense_vars["params"])
+        logits, _ = module.apply(variables, ids, mutable=["fp8_meta"])
+        assert np.isfinite(np.asarray(logits)).all()
+        # read-only apply (no mutable): keeps scales instead of crashing
+        logits_ro = module.apply(variables, ids)
+        assert np.isfinite(np.asarray(logits_ro)).all()
+
+        def loss(p):
+            out, _ = module.apply({"params": p, "fp8_meta": variables["fp8_meta"]},
+                                  ids, mutable=["fp8_meta"])
+            return (out.astype(jnp.float32) ** 2).mean()
+
+        g = jax.grad(loss)(variables["params"])
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+    def test_missing_fp8_meta_raises_clearly(self):
+        model = Fp8Dense(features=4, recipe=DelayedScalingRecipe(amax_history_len=4),
+                         dtype=jnp.float32)
+        x = jnp.ones((2, 8), jnp.float32)
+        variables = model.init(jax.random.key(0), x)
+        with pytest.raises(ValueError, match="fp8_meta"):
+            model.apply({"params": variables["params"]}, x)  # collection dropped
