@@ -217,6 +217,10 @@ class ProjectConfiguration:
     automatic_checkpoint_naming: bool = False
     total_limit: int | None = None
     iteration: int = 0
+    # background disk writes for save_state: the call returns after the
+    # device->host copy; bytes land before the next save/load/exit barrier
+    # (SURVEY §7.6 async sharded save — beyond the reference's sync save)
+    async_save: bool = False
 
     def __post_init__(self):
         if self.logging_dir is None:
@@ -1517,14 +1521,27 @@ class Accelerator:
             raise ValueError(f"Objects lack state_dict/load_state_dict: {invalid}")
         self._custom_objects.extend(objects)
 
-    def save_state(self, output_dir: str | None = None, **save_model_kwargs: Any) -> str:
+    def save_state(
+        self, output_dir: str | None = None, async_save: bool | None = None, **save_model_kwargs: Any
+    ) -> str:
+        """``async_save`` (default: ``ProjectConfiguration.async_save``) returns
+        once device arrays are copied to host; disk writes complete in the
+        background and are barriered at the next save/load/`wait_for_checkpoint`/exit."""
         from .checkpointing import get_checkpoint_dir, save_accelerator_state
 
         resolved = str(get_checkpoint_dir(self, output_dir))  # hooks see the real dir
         weights = [m.params for m in self._models]
         for hook in self._save_state_pre_hooks.values():
             hook(self._models, weights, resolved)  # hooks may replace entries
-        return save_accelerator_state(self, resolved, weights=weights)
+        if async_save is None:
+            async_save = self.project_configuration.async_save
+        return save_accelerator_state(self, resolved, weights=weights, async_save=async_save)
+
+    def wait_for_checkpoint(self) -> None:
+        """Block until every async save_state has fully landed on disk."""
+        from .checkpointing import wait_for_checkpoint_saves
+
+        wait_for_checkpoint_saves()
 
     def load_state(self, input_dir: str | None = None, **load_model_kwargs: Any) -> None:
         from .checkpointing import latest_checkpoint_dir, load_accelerator_state

@@ -15,6 +15,7 @@ sampler positions, step counters) is written by process 0 only.
 
 from __future__ import annotations
 
+import atexit
 import os
 import pickle
 import shutil
@@ -44,8 +45,38 @@ def _ocp():
     return ocp
 
 
-def _save_pytree(path: Path, tree: Any) -> None:
+# In-flight async savers (orbax ``StandardCheckpointer`` IS an
+# ``AsyncCheckpointer``: ``save`` copies device arrays to host synchronously —
+# so training may immediately mutate/donate params — then persists to disk in a
+# background thread; ``close`` joins it). SURVEY §7.6 async sharded save.
+_PENDING_SAVES: list[Any] = []
+
+
+def wait_for_checkpoint_saves() -> None:
+    """Barrier: block until every scheduled async save has fully landed on disk.
+
+    Called automatically before the next save (so directory rotation can't
+    delete a checkpoint mid-write), before any restore, and at process exit —
+    the reference's synchronous ``save_state`` semantics are thus preserved at
+    every point where they are observable."""
+    while _PENDING_SAVES:
+        ckptr = _PENDING_SAVES.pop()
+        try:
+            ckptr.wait_until_finished()
+        finally:
+            ckptr.close()
+
+
+atexit.register(wait_for_checkpoint_saves)
+
+
+def _save_pytree(path: Path, tree: Any, async_save: bool = False) -> None:
     ocp = _ocp()
+    if async_save:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path.absolute(), tree)
+        _PENDING_SAVES.append(ckptr)
+        return
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path.absolute(), tree)
 
@@ -55,6 +86,7 @@ def _restore_pytree(path: Path, target: Any | None = None) -> Any:
 
     from .state import AcceleratorState
 
+    wait_for_checkpoint_saves()
     ocp = _ocp()
     mesh = AcceleratorState().mesh if AcceleratorState._shared_state else None
 
@@ -88,6 +120,7 @@ def _restore_pytree_host(path: Path) -> Any:
     (reference `utils/fsdp_utils.py:274` merge_fsdp_weights role). A plain
     ``restore(path)`` would try to re-materialize the saved device topology
     and fail off-cluster."""
+    wait_for_checkpoint_saves()
     ocp = _ocp()
     host = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     with ocp.StandardCheckpointer() as ckptr:
@@ -121,6 +154,9 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
     base = Path(pc.project_dir or ".") / "checkpoints"
     base.mkdir(parents=True, exist_ok=True)
     if pc.automatic_checkpoint_naming:
+        # rotation may delete a directory a previous async save is still
+        # writing — land all in-flight bytes before pruning
+        wait_for_checkpoint_saves()
         existing = sorted(
             (
                 d
@@ -140,8 +176,23 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
     return base
 
 
+def _is_complete_checkpoint(d: Path) -> bool:
+    """A preemption/SIGKILL between an async save_state returning and its
+    background writes committing leaves orbax's atomic-rename temp dirs
+    (``*.orbax-checkpoint-tmp-*``) next to — instead of — the final array
+    dirs. Such a directory must not be offered to load_state(None): automatic
+    recovery should fall back to the previous intact checkpoint."""
+    try:
+        entries = list(d.iterdir())
+    except OSError:
+        return False
+    return bool(entries) and not any("orbax-checkpoint-tmp" in e.name for e in entries)
+
+
 def latest_checkpoint_dir(accelerator) -> Path:
-    """Most recent automatic checkpoint directory (for load_state(None))."""
+    """Most recent COMPLETE automatic checkpoint directory (for load_state(None));
+    directories left incomplete by a crash mid-async-write are skipped."""
+    wait_for_checkpoint_saves()  # our own in-flight saves must not look crashed
     pc = accelerator.project_configuration
     base = Path(pc.project_dir or ".") / "checkpoints"
     candidates = sorted(
@@ -150,31 +201,45 @@ def latest_checkpoint_dir(accelerator) -> Path:
             for d in base.iterdir()
             if d.name.startswith(CHECKPOINT_DIR_PREFIX + "_")
             and d.name.rsplit("_", 1)[1].isdigit()
+            and _is_complete_checkpoint(d)
         ),
         key=lambda d: int(d.name.rsplit("_", 1)[1]),
     ) if base.exists() else []
     if not candidates:
-        raise FileNotFoundError(f"No checkpoints under {base}")
+        raise FileNotFoundError(f"No complete checkpoints under {base}")
     return candidates[-1]
 
 
 def save_accelerator_state(
-    accelerator, output_dir: str | None = None, weights: list | None = None
+    accelerator,
+    output_dir: str | None = None,
+    weights: list | None = None,
+    async_save: bool = False,
 ) -> str:
     """Serialize every prepared object's state (reference `checkpointing.py:53-162`).
     ``weights`` (from the save-state pre-hooks) overrides what is persisted per
-    model, without touching the live params."""
+    model, without touching the live params.
+
+    With ``async_save`` the array pytrees are copied to host synchronously but
+    written to disk in background threads: the call returns as soon as the
+    host-side state is down, and the bytes are guaranteed on disk by the next
+    save/restore/rotation or ``wait_for_checkpoint_saves()``/process exit."""
+    wait_for_checkpoint_saves()  # at most one in-flight checkpoint generation
     out = get_checkpoint_dir(accelerator, output_dir)
     state = PartialState()
     out.mkdir(parents=True, exist_ok=True)
 
     for i, model in enumerate(accelerator._models):
-        _save_pytree(out / f"{MODEL_NAME}_{i}", weights[i] if weights is not None else model.params)
+        _save_pytree(
+            out / f"{MODEL_NAME}_{i}",
+            weights[i] if weights is not None else model.params,
+            async_save=async_save,
+        )
         if getattr(model, "extra_state", None) is not None:
-            _save_pytree(out / f"{MODEL_NAME}_{i}.extra", model.extra_state)
+            _save_pytree(out / f"{MODEL_NAME}_{i}.extra", model.extra_state, async_save=async_save)
     for i, opt in enumerate(accelerator._optimizers):
         sd = opt.state_dict()
-        _save_pytree(out / f"{OPTIMIZER_NAME}_{i}", sd["opt_state"])
+        _save_pytree(out / f"{OPTIMIZER_NAME}_{i}", sd["opt_state"], async_save=async_save)
         meta = {k: v for k, v in sd.items() if k != "opt_state"}
         meta["scaler_state"] = (
             jax.tree.map(lambda x: np.asarray(x), meta["scaler_state"]) if "scaler_state" in meta else None

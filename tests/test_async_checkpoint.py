@@ -1,0 +1,122 @@
+"""Async checkpointing (SURVEY §7.6): save_state returns after the device->host
+copy, disk writes land in background threads, and every observable point
+(next save, rotation pruning, restore, explicit wait, process exit) barriers.
+
+Reference capability anchor: `Accelerator.save_state`
+(`/root/reference/src/accelerate/accelerator.py:2953`) — synchronous there;
+the async path is TPU-first added value (multi-GB sharded saves must not
+stall the step loop).
+"""
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import checkpointing
+from accelerate_tpu.accelerator import Accelerator, ProjectConfiguration
+from accelerate_tpu.data_loader import DataLoaderShard
+from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.test_utils.training import (
+    make_regression_batches,
+    regression_apply_fn,
+    regression_loss_fn,
+    regression_model_params,
+)
+
+
+def _fresh_accelerator(**kwargs):
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    return Accelerator(**kwargs)
+
+
+def _train_once(acc, model, opt, batches):
+    for batch in DataLoaderShard(batches):
+        with acc.accumulate(model):
+            acc.backward(regression_loss_fn, batch)
+            opt.step()
+            opt.zero_grad()
+
+
+def test_async_save_snapshot_isolated_from_later_training(tmp_path):
+    """The checkpoint must hold the weights AS OF the save call even though
+    training keeps stepping while bytes are still being written."""
+    acc = _fresh_accelerator()
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.adam(0.1))
+    _train_once(acc, model, opt, make_regression_batches(4, 16))
+    snapshot_a = np.asarray(model.params["a"]).copy()
+
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), async_save=True)
+    # the async path must actually be in flight (writers not yet joined)
+    assert checkpointing._PENDING_SAVES, "async save did not schedule background writers"
+
+    # training proceeds while the save is (potentially) still writing
+    _train_once(acc, model, opt, make_regression_batches(4, 16, seed=1))
+    assert not np.allclose(np.asarray(model.params["a"]), snapshot_a)
+
+    acc.wait_for_checkpoint()
+    assert not checkpointing._PENDING_SAVES
+
+    acc.load_state(ckpt)
+    np.testing.assert_allclose(np.asarray(model.params["a"]), snapshot_a)
+    assert opt.num_updates == 4  # optimizer state is the save-time state too
+
+
+def test_load_state_barriers_inflight_save(tmp_path):
+    """Restore immediately after an async save — the restore must block until
+    the bytes are down rather than reading a partial checkpoint."""
+    acc = _fresh_accelerator()
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.adam(0.1))
+    _train_once(acc, model, opt, make_regression_batches(4, 16))
+    trained_a = np.asarray(model.params["a"]).copy()
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), async_save=True)
+    model.params = jax.tree.map(lambda p: p * 0, model.params)
+    acc.load_state(ckpt)  # no explicit wait: load itself is the barrier
+    np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
+
+
+def test_project_config_default_and_rotation_safety(tmp_path):
+    """ProjectConfiguration(async_save=True) makes it the save_state default;
+    rotation pruning with total_limit barriers before deleting directories."""
+    acc = _fresh_accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path),
+            automatic_checkpoint_naming=True,
+            total_limit=2,
+            async_save=True,
+        )
+    )
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+    for _ in range(3):
+        _train_once(acc, model, opt, make_regression_batches(2, 8))
+        acc.save_state()
+    trained_a = np.asarray(model.params["a"]).copy()
+    model.params = jax.tree.map(lambda p: p * 0, model.params)
+    acc.load_state(None)  # latest surviving checkpoint
+    np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
+
+
+def test_load_state_skips_uncommitted_checkpoint(tmp_path):
+    """A dir whose async writes never committed (preemption before the orbax
+    atomic rename) must be skipped by load_state(None) in favor of the
+    previous intact checkpoint."""
+    from accelerate_tpu.checkpointing import latest_checkpoint_dir
+
+    acc = _fresh_accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        )
+    )
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+    _train_once(acc, model, opt, make_regression_batches(2, 8))
+    acc.save_state()  # checkpoint_0: complete
+    # checkpoint_1: simulate a crash mid-async-write — host pkl down, arrays
+    # still in orbax's temp dir
+    crashed = tmp_path / "checkpoints" / "checkpoint_1"
+    (crashed / "model_0.orbax-checkpoint-tmp-1234").mkdir(parents=True)
+    (crashed / "rng_state.pkl").write_bytes(b"partial")
+    assert latest_checkpoint_dir(acc).name == "checkpoint_0"
+    trained_a = np.asarray(model.params["a"]).copy()
+    model.params = jax.tree.map(lambda p: p * 0, model.params)
+    acc.load_state(None)
+    np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
