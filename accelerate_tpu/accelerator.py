@@ -825,8 +825,30 @@ class Accelerator:
     def join_uneven_inputs(self, joinables: list, even_batches: bool | None = None):
         """API parity with DDP's Join (reference `accelerator.py:1095-1182`).
         Uneven inputs cannot reach the jitted step (the loader pads to static
-        shapes), so this is coordination-free."""
-        yield
+        shapes), so Join itself is coordination-free — but the ``even_batches``
+        override IS honored: prepared loaders (and their shard samplers) run
+        with the overridden value for the duration of the context, exactly like
+        the reference's temporary `dl.batch_sampler.even_batches` swap."""
+        overridden: list[tuple[Any, bool]] = []
+        if even_batches is not None:
+            for dl in self._dataloaders:
+                for target in (dl, getattr(dl, "batch_sampler", None)):
+                    if target is not None and hasattr(target, "even_batches"):
+                        overridden.append((target, target.even_batches))
+                        target.even_batches = even_batches
+            if not overridden:
+                import warnings
+
+                warnings.warn(
+                    "join_uneven_inputs(even_batches=...) found no prepared "
+                    "dataloaders to override; the argument has no effect.",
+                    stacklevel=2,
+                )
+        try:
+            yield
+        finally:
+            for target, prev in overridden:
+                target.even_batches = prev
 
     def _get_grad_fn(self, loss_fn: Callable, model: PreparedModel) -> Callable:
         # Keyed on live object identity via weak references: an id()-keyed dict
@@ -1491,10 +1513,15 @@ class Accelerator:
 
         return extract_model_from_parallel(model, keep_fp32_wrapper=keep_fp32_wrapper)
 
-    def get_state_dict(self, model: PreparedModel, unwrap: bool = True) -> Any:
+    def get_state_dict(self, model: PreparedModel, unwrap: bool = True, main_process_only: bool = False) -> Any:
         """Fully-gathered (unsharded) parameter pytree on host (reference
-        `accelerator.py:3329-3383` — FSDP FULL_STATE_DICT / ZeRO-3 consolidation)."""
-        return jax.tree.map(lambda p: np.asarray(operations.gather(p)) if hasattr(p, "shape") else p, model.params)
+        `accelerator.py:3329-3383` — FSDP FULL_STATE_DICT / ZeRO-3 consolidation).
+
+        Leaves stream to host one at a time. With ``main_process_only`` the
+        rank0-only consolidation semantics apply: non-main processes receive
+        ``None`` leaves and never hold a full replica (the safe mode for
+        big models — every process must still make the call, it is collective)."""
+        return operations.consolidate_on_main(model.params, keep_on_all=not main_process_only)
 
     def free_memory(self, *objects: Any) -> tuple:
         """Drop references to prepared objects and clear compiled caches
@@ -1561,7 +1588,7 @@ class Accelerator:
         from .checkpointing import save_model_weights
 
         save_model_weights(
-            self.get_state_dict(model),
+            self.get_state_dict(model, main_process_only=True),
             save_directory,
             max_shard_size=max_shard_size,
             safe_serialization=safe_serialization,

@@ -121,6 +121,13 @@ class BatchSamplerShard:
                 f"split_batches requires batch size ({self.batch_size}) divisible by "
                 f"num_processes ({num_processes})"
             )
+        if self.batch_size is None and even_batches:
+            # evening pads to the NOMINAL batch size; without one the pad target
+            # is undefined (reference `data_loader.py:158-162` same rule)
+            raise ValueError(
+                "even_batches=True requires the batch sampler to expose `batch_size`; "
+                "pass even_batches=False for samplers with variable batch sizes"
+            )
         self.drop_last = getattr(batch_sampler, "drop_last", False)
 
     @property
@@ -148,76 +155,105 @@ class BatchSamplerShard:
             yield from self._iter_round_robin()
 
     def _iter_split(self) -> Iterator[list[int]]:
-        first_batch: list[int] | None = None
-        for batch in self.batch_sampler:
-            if first_batch is None:
-                first_batch = list(batch)
-            if len(batch) == len(first_batch) and len(batch) % self.num_processes == 0:
-                shard_size = len(batch) // self.num_processes
-                start = shard_size * self.process_index
-                yield list(batch[start : start + shard_size])
-            elif self.even_batches:
-                # ragged final global batch: wrap from the first batch to refill
-                full_size = len(first_batch)
-                refill = (list(batch) + first_batch)[:full_size]
-                shard_size = full_size // self.num_processes
-                start = shard_size * self.process_index
-                yield refill[start : start + shard_size]
-            else:
-                shard_size = math.ceil(len(batch) / self.num_processes)
-                start = shard_size * self.process_index
-                piece = list(batch[start : start + shard_size])
+        """Slice math is anchored on the NOMINAL batch size (reference
+        `data_loader.py:189-209`): a ragged batch — including a first batch
+        smaller than one global batch — is refilled by cycling the epoch's
+        first batch, so every yielded slice has the static nominal/P shape."""
+        nominal = self.batch_size
+        if nominal is None:
+            # no declared batch size (ctor forces even_batches=False): pure
+            # exact partition — each batch sliced by its own ceil(len/P),
+            # empty pieces skipped (reference batch_size-None role)
+            for batch in self.batch_sampler:
+                batch = list(batch)
+                size = math.ceil(len(batch) / self.num_processes)
+                piece = batch[size * self.process_index : size * (self.process_index + 1)]
                 if piece:
                     yield piece
-
-    def _iter_round_robin(self) -> Iterator[list[int]]:
-        group: list[list[int]] = []
-        all_batches: list[list[int]] = []
-        batch_size: int | None = None
+            return
+        size = nominal // self.num_processes
+        first: list[int] | None = None
+        last: list[int] = []
         for batch in self.batch_sampler:
             batch = list(batch)
-            all_batches.append(batch)
-            if batch_size is None:
-                batch_size = len(batch)
+            if first is None:
+                first = batch
+            if last and len(last) != nominal:
+                # the slice math assumes only the FINAL batch may be ragged
+                # (torch BatchSampler invariant; the reference silently DROPS
+                # mid-stream ragged batches — raise instead of losing samples)
+                raise ValueError(
+                    f"batch of {len(last)} followed by more batches; only the final "
+                    f"batch may differ from the nominal size {nominal}"
+                )
+            last = batch
+            if len(batch) == nominal:
+                yield batch[size * self.process_index : size * (self.process_index + 1)]
+        if first is None or len(last) == nominal or self.drop_last:
+            return  # empty sampler, or no ragged tail, or tail dropped
+        if not self.even_batches:
+            piece = last[size * self.process_index : size * (self.process_index + 1)]
+            if piece:
+                yield piece
+            return
+        pool = list(first)
+        while len(pool) < nominal:  # dataset smaller than one global batch
+            pool = pool + pool
+        refill = (last + pool)[:nominal]
+        yield refill[size * self.process_index : size * (self.process_index + 1)]
+
+    def _iter_round_robin(self) -> Iterator[list[int]]:
+        """Whole batches go round-robin; a trailing group short of
+        ``num_processes`` full batches is completed by wrapping already-seen
+        indices (even_batches) or dropped whole (drop_last) — reference
+        `data_loader.py:211-257` group semantics, static nominal shapes."""
+        nominal = self.batch_size
+        group: list[list[int]] = []
+        seen: list[int] = []
+        ragged_seen = False
+        for batch in self.batch_sampler:
+            batch = list(batch)
+            if nominal is not None:
+                if ragged_seen:
+                    # padding math assumes only the FINAL batch may be ragged
+                    # (torch BatchSampler invariant; the reference silently
+                    # loses trailing batches here — raise instead)
+                    raise ValueError(
+                        "a ragged batch was followed by more batches; only the "
+                        f"final batch may differ from the nominal size {nominal}"
+                    )
+                ragged_seen = len(batch) != nominal
+            seen.extend(batch)
             group.append(batch)
-            if len(group) == self.num_processes:
-                mine = group[self.process_index]
-                if len(mine) < batch_size and self.even_batches:
-                    mine = self._refill(mine, all_batches, batch_size)
-                if len(mine) == batch_size or not self.drop_last:
-                    yield mine
+            # without a declared batch size (even_batches=False ctor-enforced)
+            # every complete group yields regardless of batch sizes
+            if len(group) == self.num_processes and (
+                nominal is None or len(group[-1]) == nominal
+            ):
+                yield group[self.process_index]
                 group = []
+        # trailing group: fewer than num_processes batches, or ragged last batch
         if not group:
             return
         if self.drop_last:
-            # incomplete trailing group: dropped whole, never wrapped — torch
-            # DataLoader drop_last semantics extend to the process group
+            # dropped whole, never wrapped — torch DataLoader drop_last
+            # semantics extend to the process group
             return
         if not self.even_batches:
-            # drop_last returned above, so the trailing piece always yields
             if self.process_index < len(group):
                 yield group[self.process_index]
             return
-        # even out the trailing partial group by wrapping whole batches from the start
-        flat = [i for b in all_batches for i in b]
-        while len(group) < self.num_processes:
-            wrap_start = (len(group) - 1) * batch_size if batch_size else 0
-            wrapped = [flat[(wrap_start + k) % len(flat)] for k in range(batch_size or 0)]
-            group.append(wrapped)
-        mine = group[self.process_index]
-        if batch_size is not None and len(mine) < batch_size:
-            mine = self._refill(mine, all_batches, batch_size)
-        yield mine
-
-    @staticmethod
-    def _refill(batch: list[int], all_batches: list[list[int]], size: int) -> list[int]:
-        flat = [i for b in all_batches for i in b]
-        out = list(batch)
+        # complete the group to num_processes full batches by cycling seen
+        # indices; each process's refill continues where the previous stopped
         k = 0
-        while len(out) < size:
-            out.append(flat[k % len(flat)])
-            k += 1
-        return out
+        filled: list[list[int]] = []
+        for i in range(self.num_processes):
+            b = list(group[i]) if i < len(group) else []
+            while len(b) < nominal:
+                b.append(seen[k % len(seen)])
+                k += 1
+            filled.append(b)
+        yield filled[self.process_index]
 
 
 class IterableDatasetShard:

@@ -345,7 +345,7 @@ def lm_loss_fn_pallas(model, batch, block_r: int | None = None, block_v: int | N
     if block_r is None:
         block_r = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
     if block_v is None:
-        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 2048)
+        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 1024)
     hidden = model(batch["input_ids"], return_hidden=True)
     labels = _next_token_labels(batch)
     b, s, e = hidden.shape

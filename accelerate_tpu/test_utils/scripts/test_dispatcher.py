@@ -1,11 +1,11 @@
-"""DataLoaderDispatcher loop on 2 real JAX processes (reference
+"""DataLoaderDispatcher loop on N real JAX processes (reference
 `test_utils/scripts/test_distributed_data_loop.py` role): process 0 reads an
 UNEVEN iterable dataset, broadcasts each global batch, every process slices its
-share; the ragged final batch is completed by wrapping and recorded in
+share (topology-generic); the ragged final batch is completed by wrapping and recorded in
 `remainder`, so gather_for_metrics returns exactly the dataset."""
 
 
-def run_checks():
+def run_checks(expected: int = 2):
     import numpy as np
 
     from accelerate_tpu.accelerator import Accelerator
@@ -13,9 +13,9 @@ def run_checks():
     from accelerate_tpu.state import PartialState
 
     state = PartialState()
-    assert state.num_processes == 2, state.num_processes
+    assert state.num_processes == expected, state.num_processes
 
-    # 27 samples in batches of 8: final batch has 3 -> not divisible by 2 procs
+    # 27 samples in batches of 8: final batch has 3 -> not divisible by the process count
     data = np.arange(27.0)
     batches = [data[i : i + 8] for i in range(0, 27, 8)]
     # only the main process actually has the dataset (iterable semantics)
@@ -29,7 +29,7 @@ def run_checks():
         sizes.append(batch.shape[0])
         seen.append(np.asarray(acc.gather_for_metrics(batch)))
     # every global batch is shape-complete (XLA equal-shard requirement)
-    assert all(s % 2 == 0 for s in sizes), sizes
+    assert all(s % state.num_processes == 0 for s in sizes), sizes
     out = np.concatenate(seen)
     np.testing.assert_array_equal(out, data)
     assert dl.remainder == 3, dl.remainder

@@ -1,30 +1,80 @@
 """Cross-process collective assertions, run under the debug/CLI launcher on N
-JAX processes (reference `test_utils/scripts/test_ops.py` pattern)."""
+JAX processes (reference `test_utils/scripts/test_ops.py` pattern). Topology-
+generic: every assertion derives its expectation from the live process count,
+so the same script validates the 2-process and 4-process tiers."""
 
-def run_checks():
+
+def run_checks(expected: int = 2):
+    import os
+    import tempfile
+
     import jax
     import numpy as np
-    assert jax.process_count() == 2, jax.process_count()
+
+    assert jax.process_count() == expected, jax.process_count()
     from accelerate_tpu.state import PartialState
     from accelerate_tpu.utils import operations
+
     state = PartialState()
-    assert state.num_processes == 2
+    n, p = state.num_processes, state.process_index
+    assert n == expected
+
     # object all-gather across processes
-    got = operations.gather_object([f"proc{state.process_index}"])
-    assert got == ["proc0", "proc1"], got
+    got = operations.gather_object([f"proc{p}"])
+    assert got == [f"proc{i}" for i in range(n)], got
+
     # tensor gather across processes
-    x = np.full((2,), float(state.process_index))
+    x = np.full((2,), float(p))
     g = operations.gather(x)
-    np.testing.assert_array_equal(np.asarray(g).ravel(), [0.0, 0.0, 1.0, 1.0])
-    # broadcast
-    b = operations.broadcast(np.full((3,), float(state.process_index + 5)), from_process=1)
-    np.testing.assert_array_equal(np.asarray(b), [6.0, 6.0, 6.0])
+    np.testing.assert_array_equal(
+        np.asarray(g).ravel(), np.repeat(np.arange(float(n)), 2)
+    )
+
+    # broadcast from the LAST (nonzero) rank — exercises the rotate-to-0 path
+    b = operations.broadcast(np.full((3,), float(p + 5)), from_process=n - 1)
+    np.testing.assert_array_equal(np.asarray(b), np.full((3,), float(n - 1 + 5)))
+
+    # object broadcast from a nonzero rank
+    objs = operations.broadcast_object_list([f"payload{p}", p * 10], from_process=n - 1)
+    assert objs == [f"payload{n - 1}", (n - 1) * 10], objs
+
+    # pad_across_processes: ragged per-process lengths pad to the global max
+    ragged = np.arange(float(p + 1))  # proc i has i+1 elements
+    padded = operations.pad_across_processes(ragged, dim=0)
+    assert padded.shape[0] == n, padded.shape
+    np.testing.assert_array_equal(np.asarray(padded)[: p + 1], ragged)
+    np.testing.assert_array_equal(np.asarray(padded)[p + 1 :], 0.0)
+
+    # main_process_first really orders main's body before every other process.
+    # The marker-file proof needs a shared filesystem, so it only runs when the
+    # coordinator is loopback (all processes on this host — the debug-launcher
+    # tier); on a real pod the context still executes, unasserted.
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    single_host = coordinator.startswith(("127.", "localhost"))
+    marker = os.path.join(
+        tempfile.gettempdir(), "mpf_" + coordinator.replace(":", "_").replace(".", "_")
+    )
+    if single_host and state.is_main_process and os.path.exists(marker):
+        os.remove(marker)  # stale marker from a crashed earlier run
     state.wait_for_everyone()
-    print(f"proc {state.process_index}: multihost collectives OK", flush=True)
+    with state.main_process_first():
+        if state.is_main_process:
+            with open(marker, "w") as f:
+                f.write("main was here")
+        elif single_host:
+            assert os.path.exists(marker), "main_process_first did not run main first"
+    state.wait_for_everyone()
+    if single_host and state.is_main_process:
+        os.remove(marker)
+
+    state.wait_for_everyone()
+    print(f"proc {p}/{n}: multihost collectives OK", flush=True)
 
 
 if __name__ == "__main__":
+    import os
+
     from accelerate_tpu.state import PartialState
 
     PartialState()
-    run_checks()
+    run_checks(int(os.environ.get("ACCELERATE_TPU_NUM_PROCESSES", "2")))

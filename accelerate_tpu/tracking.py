@@ -55,8 +55,75 @@ class GeneralTracker:
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
         raise NotImplementedError
 
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Log a dict of name -> image array (HWC or NHWC, float [0,1] or uint8)
+        — reference `tracking.py:251/341/540/804` per-integration variants."""
+        raise NotImplementedError(f"Tracker {self.name!r} does not support log_images")
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: list[str] | None = None,
+        data: list[list[Any]] | None = None,
+        dataframe: Any = None,
+        step: int | None = None,
+        **kwargs: Any,
+    ) -> None:
+        """Log tabular data as ``columns`` + ``data`` rows or a dataframe —
+        reference `tracking.py:360/822`."""
+        raise NotImplementedError(f"Tracker {self.name!r} does not support log_table")
+
     def finish(self) -> None:
         pass
+
+
+def _table_rows(columns, data, dataframe):
+    """Normalize the (columns, data) / dataframe dual input to (columns, rows)."""
+    if dataframe is not None:
+        return list(map(str, dataframe.columns)), dataframe.values.tolist()
+    if data is None:
+        raise ValueError("log_table needs either `data` (+ optional `columns`) or `dataframe`")
+    if columns is None:
+        columns = [f"col_{i}" for i in range(len(data[0]))] if data else []
+    return columns, data
+
+
+def _image_to_uint8_hwc(img: Any):
+    """Accept HW, HWC, or CHW-ish arrays in float [0,1] or uint8; return uint8 HWC."""
+    import numpy as np
+
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.ndim != 3:
+        raise ValueError(f"expected HW or HWC image, got shape {arr.shape}")
+    if arr.shape[0] in (1, 3, 4) and arr.shape[2] not in (1, 3, 4):
+        arr = np.moveaxis(arr, 0, -1)  # CHW -> HWC
+    if arr.dtype != np.uint8:
+        arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+    return arr
+
+
+def _images_as_hwc_list(v: Any) -> list:
+    """A single image (HW/HWC/CHW) or an NHWC batch -> list of uint8 HWC arrays."""
+    import numpy as np
+
+    arr = np.asarray(v)
+    if arr.ndim == 4:
+        return [_image_to_uint8_hwc(x) for x in arr]
+    return [_image_to_uint8_hwc(arr)]
+
+
+def _expand_image_keys(values: dict):
+    """Flatten {name: image-or-batch} to (key, hwc) pairs, suffixing batch
+    members with _<i> so every integration handles NHWC input uniformly."""
+    for k, v in values.items():
+        imgs = _images_as_hwc_list(v)
+        if len(imgs) == 1:
+            yield k, imgs[0]
+        else:
+            for i, img in enumerate(imgs):
+                yield f"{k}_{i}", img
 
 
 class JSONLTracker(GeneralTracker):
@@ -89,6 +156,28 @@ class JSONLTracker(GeneralTracker):
         entry["_ts"] = time.time()
         self._fh.write(json.dumps(entry) + "\n")
         self._fh.flush()
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Dependency-free image logging: pixels land as .npy files next to the
+        metrics file; the jsonl row records their paths."""
+        import numpy as np
+
+        media_dir = os.path.join(os.path.dirname(self.path), f"{self.run_name}.media")
+        os.makedirs(media_dir, exist_ok=True)
+        paths = {}
+        for k, img in _expand_image_keys(values):
+            safe = k.replace("/", "_")
+            out = os.path.join(media_dir, f"{safe}_{step if step is not None else 'x'}.npy")
+            np.save(out, img)
+            paths[k] = out
+        self.log({"_images": paths}, step=step)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        columns, rows = _table_rows(columns, data, dataframe)
+        self.log({"_table": {"name": table_name, "columns": columns,
+                             "rows": [[str(c) for c in r] for r in rows]}}, step=step)
 
     @on_main_process
     def finish(self) -> None:
@@ -131,6 +220,32 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Reference `tracking.py:251` (`add_images`); accepts HWC/NHWC arrays."""
+        import numpy as np
+
+        for k, v in values.items():
+            arr = np.asarray(v)
+            if arr.ndim == 4:  # NHWC batch
+                batch = np.stack([_image_to_uint8_hwc(x) for x in arr])
+                self.writer.add_images(k, batch, global_step=step, dataformats="NHWC", **kwargs)
+            else:
+                self.writer.add_image(k, _image_to_uint8_hwc(arr), global_step=step,
+                                      dataformats="HWC", **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """Rendered as a markdown text summary (TB has no native table op)."""
+        columns, rows = _table_rows(columns, data, dataframe)
+        md = "| " + " | ".join(map(str, columns)) + " |\n"
+        md += "|" + "---|" * len(columns) + "\n"
+        for r in rows:
+            md += "| " + " | ".join(str(c) for c in r) + " |\n"
+        self.writer.add_text(table_name, md, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
     def finish(self) -> None:
         self.writer.close()
 
@@ -160,6 +275,29 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Reference `tracking.py:341`."""
+        import wandb
+
+        self.run.log(
+            {k: [wandb.Image(img, **kwargs) for img in _images_as_hwc_list(v)]
+             for k, v in values.items()},
+            step=step,
+        )
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """Reference `tracking.py:360`."""
+        import wandb
+
+        if dataframe is not None:
+            table = wandb.Table(dataframe=dataframe, **kwargs)
+        else:
+            columns, rows = _table_rows(columns, data, None)
+            table = wandb.Table(columns=list(columns), data=rows, **kwargs)
+        self.run.log({table_name: table}, step=step)
 
     @on_main_process
     def finish(self) -> None:
@@ -197,6 +335,24 @@ class MLflowTracker(GeneralTracker):
         mlflow.log_metrics(metrics, step=step)
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Reference `tracking.py:540` (`mlflow.log_image`)."""
+        import mlflow
+
+        for k, img in _expand_image_keys(values):
+            mlflow.log_image(img, key=k, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        import mlflow
+
+        if dataframe is None:
+            columns, rows = _table_rows(columns, data, None)
+            dataframe = {c: [r[i] for r in rows] for i, c in enumerate(columns)}
+        artifact = table_name if table_name.endswith(".json") else f"{table_name}.json"
+        mlflow.log_table(data=dataframe, artifact_file=artifact, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
         import mlflow
 
@@ -229,6 +385,25 @@ class CometMLTracker(GeneralTracker):
         self.writer.log_metrics(values, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        for k, img in _expand_image_keys(values):
+            self.writer.log_image(img, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        if dataframe is not None:
+            self.writer.log_table(
+                table_name if table_name.endswith((".json", ".csv", ".md")) else f"{table_name}.csv",
+                tabular_data=dataframe, **kwargs)
+        else:
+            columns, rows = _table_rows(columns, data, None)
+            self.writer.log_table(
+                table_name if table_name.endswith((".json", ".csv", ".md")) else f"{table_name}.csv",
+                tabular_data=[columns] + rows, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
         self.writer.end()
 
@@ -257,6 +432,13 @@ class AimTracker(GeneralTracker):
     def log(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
         for k, v in values.items():
             self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        from aim import Image
+
+        for k, img in _expand_image_keys(values):
+            self.writer.track(Image(img), name=k, step=step, **kwargs)
 
     @on_main_process
     def finish(self) -> None:
@@ -289,6 +471,26 @@ class ClearMLTracker(GeneralTracker):
                 logger.report_scalar(title=k, series=k, value=v, iteration=step or 0)
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        """Reference `tracking.py:804`."""
+        logger = self.task.get_logger()
+        for k, img in _expand_image_keys(values):
+            logger.report_image(title=k, series=k, iteration=step or 0,
+                                image=img, **kwargs)
+
+    @on_main_process
+    def log_table(self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs):
+        """Reference `tracking.py:822`."""
+        logger = self.task.get_logger()
+        if dataframe is None:
+            columns, rows = _table_rows(columns, data, None)
+            import pandas as pd
+
+            dataframe = pd.DataFrame(rows, columns=columns)
+        logger.report_table(title=table_name, series=table_name, iteration=step or 0,
+                            table_plot=dataframe, **kwargs)
+
+    @on_main_process
     def finish(self) -> None:
         self.task.close()
 
@@ -319,6 +521,14 @@ class DVCLiveTracker(GeneralTracker):
             if isinstance(v, (int, float)):
                 self.live.log_metric(k, v)
         self.live.next_step()
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs: Any) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, img in _expand_image_keys(values):
+            name = k if k.endswith((".png", ".jpg")) else f"{k}.png"
+            self.live.log_image(name, img, **kwargs)
 
     @on_main_process
     def finish(self) -> None:

@@ -11,6 +11,7 @@ import sys
 import tempfile
 from pathlib import Path
 
+import numpy as np
 import optax
 
 sys.path.insert(0, str(Path(__file__).parent))
@@ -48,6 +49,18 @@ def main() -> None:
         acc = evaluate(accelerator, model, eval_dl)
         accelerator.log({"accuracy": acc, "epoch": epoch}, step=global_step)
         accelerator.print(f"epoch {epoch}: loss={float(loss):.4f} accuracy={acc:.3f}")
+    # media logging: images + a summary table on every tracker that supports it
+    if accelerator.is_main_process:
+        heat = np.abs(np.asarray(model.params["w1"]))  # (features, hidden) heatmap
+        for tracker in accelerator.trackers:
+            try:
+                tracker.log_images({"viz/weight_magnitude": heat / max(heat.max(), 1e-8)},
+                                   step=global_step)
+                tracker.log_table("final_metrics", columns=["metric", "value"],
+                                  data=[["accuracy", acc], ["final_loss", float(loss)]],
+                                  step=global_step)
+            except NotImplementedError:
+                pass
     accelerator.end_training()
     accelerator.print(f"metrics logged under {project_dir}")
 

@@ -1,0 +1,215 @@
+"""Exhaustive (even_batches x split_batches x drop_last x size x batch x P)
+index-math property matrix for BatchSamplerShard and IterableDatasetShard —
+the reference's `tests/test_data_loader.py` (809 LoC of enumerated expected
+index lists) expressed as properties asserted over the full combinatorial grid,
+including every wrap/refill edge (dataset smaller than one batch, smaller than
+one process group, prime sizes, exact multiples).
+"""
+
+import math
+
+import pytest
+
+from accelerate_tpu.data_loader import BatchSamplerShard, IterableDatasetShard
+
+
+class SimpleBatchSampler:
+    """torch.utils.data.BatchSampler semantics without torch."""
+
+    def __init__(self, n, batch_size, drop_last=False):
+        self.n, self.batch_size, self.drop_last = n, batch_size, drop_last
+
+    def __iter__(self):
+        batch = []
+        for i in range(self.n):
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return math.ceil(self.n / self.batch_size)
+
+
+SIZES = [1, 2, 3, 5, 7, 8, 11, 12, 16, 17, 24, 29]
+BATCH_SIZES = [1, 2, 3, 4]
+PROCS = [1, 2, 3, 4]
+
+
+def _all_shards(n, bs, P, split_batches, even_batches, drop_last):
+    return [
+        list(
+            BatchSamplerShard(
+                SimpleBatchSampler(n, bs, drop_last),
+                P,
+                p,
+                split_batches=split_batches,
+                even_batches=even_batches,
+            )
+        )
+        for p in range(P)
+    ]
+
+
+def _flatten(shard):
+    return [i for b in shard for i in b]
+
+
+@pytest.mark.parametrize("P", PROCS)
+@pytest.mark.parametrize("bs", BATCH_SIZES)
+@pytest.mark.parametrize("n", SIZES)
+class TestRoundRobinMatrix:
+    """split_batches=False: whole batches round-robin across processes."""
+
+    def test_even_batches_static_shapes_and_coverage(self, n, bs, P):
+        """even_batches=True, drop_last=False: every process yields the same
+        number of batches, every batch has exactly bs indices, every dataset
+        index appears somewhere, and wrap duplicates only come from the
+        dataset start (reference wrap semantics)."""
+        shards = _all_shards(n, bs, P, False, True, False)
+        counts = {len(s) for s in shards}
+        assert counts == {len(shards[0])}, "processes yielded different batch counts"
+        for s in shards:
+            assert all(len(b) == bs for b in s), f"non-static batch in {s}"
+        seen = set().union(*(set(_flatten(s)) for s in shards))
+        assert seen == set(range(n)), "some dataset index never yielded"
+        # len() contract matches actual iteration for every process
+        for p, s in enumerate(shards):
+            bss = BatchSamplerShard(SimpleBatchSampler(n, bs, False), P, p)
+            assert len(bss) == len(s), f"__len__ {len(bss)} != yielded {len(s)} (p={p})"
+
+    def test_even_batches_first_pass_order_preserved(self, n, bs, P):
+        """Before any wrapping, batch k of the base sampler goes to process
+        k % P in order — interleaving the shards reconstructs the base
+        sampler's prefix exactly."""
+        shards = _all_shards(n, bs, P, False, True, False)
+        base = list(SimpleBatchSampler(n, bs, False))
+        full_groups = len(base) // P
+        for g in range(full_groups):
+            for p in range(P):
+                if len(base[g * P + p]) == bs:  # ragged tail legitimately wraps
+                    assert shards[p][g] == base[g * P + p]
+
+    def test_drop_last_group_semantics(self, n, bs, P):
+        """drop_last=True: a trailing group with fewer than P full batches is
+        dropped whole; every yielded batch is full; interleaved shards equal
+        the base sampler's kept prefix; len() matches."""
+        shards = _all_shards(n, bs, P, False, True, True)
+        base = list(SimpleBatchSampler(n, bs, True))  # only full batches
+        kept_groups = len(base) // P
+        for p, s in enumerate(shards):
+            assert len(s) == kept_groups
+            assert all(len(b) == bs for b in s)
+            bss = BatchSamplerShard(SimpleBatchSampler(n, bs, True), P, p)
+            assert len(bss) == len(s)
+        interleaved = [shards[p][g] for g in range(kept_groups) for p in range(P)]
+        assert interleaved == base[: kept_groups * P]
+
+    def test_uneven_exact_partition(self, n, bs, P):
+        """even_batches=False, drop_last=False: shards partition the base
+        sampler's batches exactly — no wrap, no duplicate, no loss — and
+        len() matches per process."""
+        shards = _all_shards(n, bs, P, False, False, False)
+        base = list(SimpleBatchSampler(n, bs, False))
+        reconstructed = []
+        for g in range(math.ceil(len(base) / P)):
+            for p in range(P):
+                idx = g * P + p
+                if idx < len(base):
+                    assert g < len(shards[p]), f"process {p} missing batch {idx}"
+                    reconstructed.append(shards[p][g])
+        assert reconstructed == base
+        for p, s in enumerate(shards):
+            bss = BatchSamplerShard(
+                SimpleBatchSampler(n, bs, False), P, p, even_batches=False
+            )
+            assert len(bss) == len(s)
+
+
+@pytest.mark.parametrize("P", PROCS)
+@pytest.mark.parametrize("bs", BATCH_SIZES)
+@pytest.mark.parametrize("n", SIZES)
+class TestSplitBatchesMatrix:
+    """split_batches=True: each global batch is cut into P contiguous slices.
+    The underlying batch size must divide by P (constructor-enforced)."""
+
+    def _skip_indivisible(self, bs, P):
+        if bs % P != 0:
+            pytest.skip("split_batches requires bs % P == 0")
+
+    def test_even_full_coverage_and_static_shapes(self, n, bs, P):
+        self._skip_indivisible(bs, P)
+        shards = _all_shards(n, bs, P, True, True, False)
+        base = list(SimpleBatchSampler(n, bs, False))
+        shard_size = bs // P
+        for s in shards:
+            assert len(s) == len(base)
+            assert all(len(b) == shard_size for b in s)
+        # full batches slice contiguously: concatenating the P slices of
+        # global batch g reproduces it; ragged final batch refills from batch 0
+        for g, b in enumerate(base):
+            glued = [i for p in range(P) for i in shards[p][g]]
+            if len(b) == bs:
+                assert glued == b
+            else:
+                assert glued[: len(b)] == b
+                pool = list(base[0])
+                while len(pool) < bs:  # degenerate: dataset < one global batch
+                    pool = pool + pool
+                assert glued == (b + pool)[:bs]
+        seen = set().union(*(set(_flatten(s)) for s in shards))
+        assert seen == set(range(n))
+
+    def test_uneven_nominal_slice(self, n, bs, P):
+        """even_batches=False: ragged tail slices by nominal bs//P (reference
+        `data_loader.py:201-204`); empty pieces are skipped, every index of
+        every batch appears exactly once, in slice order."""
+        self._skip_indivisible(bs, P)
+        shards = _all_shards(n, bs, P, True, False, False)
+        base = list(SimpleBatchSampler(n, bs, False))
+        size = bs // P
+        for g, b in enumerate(base):
+            glued = []
+            for p in range(P):
+                piece = b[p * size : (p + 1) * size]
+                if piece:
+                    assert shards[p][g] == piece
+                    glued.extend(piece)
+            assert glued == b
+
+
+@pytest.mark.parametrize("P", [1, 2, 3, 4])
+@pytest.mark.parametrize("bs", [2, 4])
+@pytest.mark.parametrize("n", [1, 4, 7, 8, 15, 16, 29])
+class TestIterableShardMatrix:
+    def test_even_coverage_and_uniform_shares(self, n, bs, P):
+        """even (default): all processes see equal-size shares per buffered
+        batch; union covers the dataset; wrap duplicates only when the global
+        buffer is ragged."""
+        shards = [
+            list(IterableDatasetShard(range(n), batch_size=bs, num_processes=P, process_index=p))
+            for p in range(P)
+        ]
+        lens = {len(s) for s in shards}
+        assert lens == {len(shards[0])}
+        assert set().union(*(set(s) for s in shards)) == set(range(n))
+
+    def test_drop_last_no_duplicates_exact_partition(self, n, bs, P):
+        """drop_last: only full global buffers are split — no index repeats,
+        nothing wraps, every kept index appears exactly once."""
+        shards = [
+            list(
+                IterableDatasetShard(
+                    range(n), batch_size=bs, num_processes=P, process_index=p, drop_last=True
+                )
+            )
+            for p in range(P)
+        ]
+        all_idx = [i for s in shards for i in s]
+        assert len(all_idx) == len(set(all_idx)), "duplicate index under drop_last"
+        kept = (n // (bs * P)) * bs * P
+        assert sorted(all_idx) == list(range(kept))

@@ -270,6 +270,57 @@ def test_dispatcher_script_multidevice():
         os.environ.update(env_backup)
 
 
+@pytest.mark.slow
+def test_multiprocess_ops_script_4proc():
+    """Tier-2 at 4 processes (VERDICT r4 #5): gather/broadcast-from-rank-3/
+    object collectives/pad_across_processes/main_process_first under a real
+    4-process jax.distributed world."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_multiprocess_ops
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_multiprocess_ops.run_checks, args=(4,), num_processes=4)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_dispatcher_script_4proc():
+    """Tier-2 at 4 processes: dispatcher uneven-dataset loop — final batch of
+    3 wraps to the 4-process shard multiple, metrics stay dataset-exact."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_dispatcher
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(test_dispatcher.run_checks, args=(4,), num_processes=4)
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_script_4proc(tmp_path):
+    """Tier-2 at 4 processes: orbax sharded save -> fresh objects -> bit-exact
+    resume across a real 4-process world."""
+    from accelerate_tpu.launchers import debug_launcher
+    from accelerate_tpu.test_utils.scripts import test_checkpoint_resume
+
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = str(REPO) + os.pathsep + os.environ.get("PYTHONPATH", "")
+    try:
+        debug_launcher(
+            test_checkpoint_resume.run_checks, args=(str(tmp_path / "ckpt"), 4), num_processes=4
+        )
+    finally:
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
 def _run_notebook_sim(body: str, tmp_path, timeout: int = 300) -> subprocess.CompletedProcess:
     """Run ``body`` in a fresh interpreter simulating a notebook kernel: no JAX
     touched yet, function defined at 'cell' scope (inside main(), NOT importable),

@@ -255,3 +255,56 @@ def test_get_pretty_name_fallbacks():
 
     assert at.get_pretty_name(5) == "int"
     assert at.get_pretty_name(at.Accelerator) == "Accelerator"
+
+
+class TestConsolidateOnMain:
+    """Streaming host-0 consolidation (reference accelerator.py:3329-3383
+    FULL_STATE_DICT rank0-only role)."""
+
+    def _sharded_tree(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+        sharding = NamedSharding(mesh, PartitionSpec("data"))
+        return {
+            "w": jax.device_put(jnp.arange(16.0).reshape(8, 2), sharding),
+            "meta": "keep-as-is",
+            "b": np.arange(4.0),
+        }
+
+    def test_main_process_keeps_everything(self):
+        from accelerate_tpu.utils.operations import consolidate_on_main
+
+        tree = self._sharded_tree()
+        out = consolidate_on_main(tree)
+        assert isinstance(out["w"], np.ndarray) and out["w"].shape == (8, 2)
+        np.testing.assert_array_equal(out["w"], np.arange(16.0).reshape(8, 2))
+        np.testing.assert_array_equal(out["b"], np.arange(4.0))
+        assert out["meta"] == "keep-as-is"
+
+    def test_non_main_gets_none_leaves(self):
+        from accelerate_tpu.state import PartialState
+        from accelerate_tpu.utils.operations import consolidate_on_main
+
+        tree = self._sharded_tree()
+        state = PartialState()
+        state.process_index = 1  # impersonate a worker (reset by fixture)
+        try:
+            out = consolidate_on_main(tree)
+        finally:
+            state.process_index = 0
+        assert out["w"] is None and out["b"] is None
+        assert out["meta"] == "keep-as-is"
+
+    def test_keep_on_all_matches_gather(self):
+        from accelerate_tpu.state import PartialState
+        from accelerate_tpu.utils.operations import consolidate_on_main
+
+        tree = self._sharded_tree()
+        state = PartialState()
+        state.process_index = 1
+        try:
+            out = consolidate_on_main(tree, keep_on_all=True)
+        finally:
+            state.process_index = 0
+        np.testing.assert_array_equal(out["w"], np.arange(16.0).reshape(8, 2))

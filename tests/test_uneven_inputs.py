@@ -108,3 +108,28 @@ def test_join_uneven_inputs_is_documented_noop():
     acc = _fresh()
     with acc.join_uneven_inputs([object()]):
         pass
+
+
+def test_join_uneven_inputs_honors_even_batches_override():
+    """The even_batches override must reach the prepared loader (and its shard
+    sampler) for the duration of the context — reference
+    `accelerator.py:1095-1182` temporary even_batches swap."""
+    acc = _fresh()
+    dl = acc.prepare(_torch_loader(11, bs=8))
+    assert dl.even_batches
+    with acc.join_uneven_inputs([object()], even_batches=False):
+        assert not dl.even_batches
+        sampler = dl.batch_sampler
+        if sampler is not None and hasattr(sampler, "even_batches"):
+            assert not sampler.even_batches
+        # uneven iteration inside the context: the ragged tail stays ragged
+        sizes = [np.asarray(b["idx"]).shape[0] for b in dl]
+        assert sum(sizes) >= 11
+    assert dl.even_batches  # restored on exit
+
+
+def test_join_uneven_inputs_warns_without_loaders():
+    acc = _fresh()
+    with pytest.warns(UserWarning, match="no prepared dataloaders"):
+        with acc.join_uneven_inputs([object()], even_batches=False):
+            pass
