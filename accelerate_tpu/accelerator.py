@@ -833,9 +833,24 @@ class Accelerator:
         if even_batches is not None:
             for dl in self._dataloaders:
                 for target in (dl, getattr(dl, "batch_sampler", None)):
-                    if target is not None and hasattr(target, "even_batches"):
-                        overridden.append((target, target.even_batches))
-                        target.even_batches = even_batches
+                    if target is None or not hasattr(target, "even_batches"):
+                        continue
+                    if even_batches and getattr(target, "batch_size", 0) is None:
+                        # same invariant as the BatchSamplerShard constructor:
+                        # even_batches needs a declared batch_size to pad to —
+                        # overriding past it would crash the trailing-group
+                        # refill mid-iteration
+                        import warnings
+
+                        warnings.warn(
+                            "join_uneven_inputs(even_batches=True) skipped a "
+                            "loader whose batch sampler exposes no batch_size; "
+                            "it keeps even_batches=False.",
+                            stacklevel=2,
+                        )
+                        continue
+                    overridden.append((target, target.even_batches))
+                    target.even_batches = even_batches
             if not overridden:
                 import warnings
 

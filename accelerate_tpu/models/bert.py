@@ -142,6 +142,64 @@ class BertForSequenceClassification(nn.Module):
         return self.init(rng, ids)["params"]
 
 
+def bert_blockwise(config: BertConfig):
+    """Decompose BertForSequenceClassification into sequential blocks:
+    embed -> layer_i... -> head (pooler + classifier), for blockwise offload
+    streaming (`big_modeling.BlockwiseModel`) and PP inference
+    (`inference.prepare_pippy`, reference `examples/inference/pippy/bert.py`).
+
+    The PP path threads ONE activation through the stages, so the optional
+    padding `attention_mask` is not plumbed — pipeline pad-free batches (the
+    reference pippy examples trace example inputs without masks too).
+    Pair with `bert_blockwise_state_dict`."""
+    from ..big_modeling import BlockwiseModel
+
+    cfg = config
+
+    def embed_fn(p, input_ids):
+        b, s = input_ids.shape
+        x = (
+            p["word_embeddings"][input_ids]
+            + p["position_embeddings"][None, :s]
+            + p["token_type_embeddings"][jnp.zeros_like(input_ids)]
+        ).astype(cfg.dtype)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype).apply({"params": p["ln_embed"]}, x)
+        return x.astype(cfg.dtype)
+
+    def make_block_fn(i):
+        def block_fn(p, x):
+            return BertLayer(cfg, name=f"layer_{i}").apply({"params": p}, x)
+
+        return block_fn
+
+    def head_fn(p, x):
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+            .apply({"params": p["pooler"]}, x[:, 0])
+        )
+        return nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=cfg.param_dtype) \
+            .apply({"params": p["classifier"]}, pooled.astype(jnp.float32))
+
+    fns = [("embed", embed_fn)]
+    fns += [(f"layer_{i}", make_block_fn(i)) for i in range(cfg.num_layers)]
+    fns += [("head", head_fn)]
+    return BlockwiseModel(block_fns=fns)
+
+
+def bert_blockwise_state_dict(params: dict) -> dict:
+    """Regroup a BertForSequenceClassification param tree into the blockwise
+    layout (embed group, per-layer groups, pooler+classifier head group)."""
+    bert = params["bert"]
+    out = {"embed": {k: bert[k] for k in (
+        "word_embeddings", "position_embeddings", "token_type_embeddings", "ln_embed")}}
+    for k in bert:
+        if k.startswith("layer_"):
+            out[k] = bert[k]
+    out["head"] = {"pooler": bert["pooler"], "classifier": params["classifier"]}
+    return out
+
+
 def bert_sharding_rules() -> ShardingRules:
     """Megatron-style TP for the encoder (same column/row pattern as GPT-2)."""
     return ShardingRules(

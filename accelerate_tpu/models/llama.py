@@ -253,6 +253,49 @@ def llama_sharding_rules(config: LlamaConfig | None = None) -> ShardingRules:
     )
 
 
+def llama_blockwise(config: LlamaConfig):
+    """Decompose Llama into sequential blocks: embed -> layer_i... -> head.
+
+    Serves both L5 flows (reference roles): blockwise offload-streaming
+    inference (`big_modeling.BlockwiseModel`) and pipeline-parallel inference
+    (`inference.prepare_pippy`, reference `examples/inference/pippy/llama.py`).
+    Pair with `llama_blockwise_state_dict` to regroup a param tree."""
+    from ..big_modeling import BlockwiseModel
+
+    def embed_fn(p, input_ids):
+        return p["embed_tokens"].astype(config.dtype)[input_ids]
+
+    def make_block_fn(i):
+        def block_fn(p, x):
+            return LlamaBlock(config, name=f"layer_{i}").apply({"params": p}, x)
+
+        return block_fn
+
+    def head_fn(p, x):
+        x = RMSNorm(config.rms_norm_eps, config.param_dtype, name="final_norm").apply(
+            {"params": p["final_norm"]}, x
+        )
+        return jnp.einsum(
+            "bse,ve->bsv", x.astype(config.dtype), p["lm_head"].astype(config.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    fns = [("embed", embed_fn)]
+    fns += [(f"layer_{i}", make_block_fn(i)) for i in range(config.num_layers)]
+    fns += [("head", head_fn)]
+    return BlockwiseModel(block_fns=fns)
+
+
+def llama_blockwise_state_dict(params: dict) -> dict:
+    """Regroup a LlamaForCausalLM param tree into the blockwise layout."""
+    out = {"embed": {"embed_tokens": params["embed_tokens"]}}
+    for k in params:
+        if k.startswith("layer_"):
+            out[k] = params[k]
+    out["head"] = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    return out
+
+
 def llama_loss_fn(model, batch) -> jax.Array:
     from .gpt2 import cross_entropy_loss
 

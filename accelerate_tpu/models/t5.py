@@ -273,6 +273,98 @@ def t5_sharding_rules() -> ShardingRules:
     )
 
 
+def t5_pipeline_forward(
+    config: T5Config,
+    params: dict,
+    mesh=None,
+    num_microbatches: int | None = None,
+    axis_name: str = "stage",
+):
+    """Pipeline-parallel T5 inference: both stacks pipelined over the ``stage``
+    mesh axis (reference `examples/inference/pippy/t5.py` role — PiPPy splits
+    the whole encoder-decoder; here each stack runs as its own GPipe SPMD
+    program, the TPU-native equivalent).
+
+    The decoder stage activation is the pytree ``(hidden, encoder_out)``:
+    encoder output rides through every decoder stage unchanged so cross-
+    attention reads it stage-locally — no per-rank broadcast program, unlike
+    PiPPy's send/recv graph. The shared relative-bias table is duplicated into
+    every stage's param group (it is tiny: num_buckets x num_heads).
+
+    Returns ``forward(input_ids, decoder_input_ids) -> fp32 logits`` (jitted).
+    Pad-free batches: padding masks are not plumbed through the pipeline.
+    """
+    from ..parallel.pipeline import pipeline_apply, stack_stage_params
+    from ..state import PartialState
+
+    cfg = config
+    if mesh is None:
+        mesh = PartialState().mesh
+    S = mesh.shape.get(axis_name, 1)
+    if S <= 1:
+        raise ValueError(
+            f"t5_pipeline_forward needs a non-trivial '{axis_name}' mesh axis")
+    if cfg.num_layers % S or cfg.num_decoder_layers % S:
+        raise ValueError(
+            f"num_layers {cfg.num_layers} and num_decoder_layers "
+            f"{cfg.num_decoder_layers} must both divide into {S} stages")
+    M = num_microbatches or S
+    per_e, per_d = cfg.num_layers // S, cfg.num_decoder_layers // S
+
+    def _stack(side: str, per: int) -> Any:
+        groups = [
+            {
+                "rel_bias": params[side]["rel_bias"],
+                **{f"layer_{j}": params[side][f"block_{s * per + j}"] for j in range(per)},
+            }
+            for s in range(S)
+        ]
+        return stack_stage_params(groups)
+
+    enc_stacked, dec_stacked = _stack("encoder", per_e), _stack("decoder", per_d)
+
+    def enc_stage_fn(p, x):
+        s = x.shape[1]
+        bias = T5RelativeBias(cfg, bidirectional=True).apply({"params": p["rel_bias"]}, s, s)
+        for j in range(per_e):
+            x = T5Block(cfg, is_decoder=False, name=f"layer_{j}").apply(
+                {"params": p[f"layer_{j}"]}, x, bias
+            )
+        return x
+
+    def dec_stage_fn(p, xe):
+        x, enc = xe
+        s = x.shape[1]
+        bias = T5RelativeBias(cfg, bidirectional=False).apply({"params": p["rel_bias"]}, s, s)
+        for j in range(per_d):
+            x = T5Block(cfg, is_decoder=True, name=f"layer_{j}").apply(
+                {"params": p[f"layer_{j}"]}, x, bias, enc_out=enc
+            )
+        return x, enc
+
+    shared = params["shared_embedding"]
+    ln = lambda side, x: T5LayerNorm(cfg).apply({"params": params[side]["ln_final"]}, x)
+
+    @jax.jit
+    def forward(input_ids: jax.Array, decoder_input_ids: jax.Array) -> jax.Array:
+        emb = shared.astype(cfg.dtype)
+        enc_x = emb[input_ids]
+        enc_out = pipeline_apply(
+            enc_stage_fn, enc_stacked, enc_x, mesh, M, axis_name=axis_name
+        )
+        enc_out = ln("encoder", enc_out)
+        dec_x = emb[decoder_input_ids]
+        dec_out, _ = pipeline_apply(
+            dec_stage_fn, dec_stacked, (dec_x, enc_out), mesh, M, axis_name=axis_name
+        )
+        dec_out = ln("decoder", dec_out).astype(jnp.float32)
+        if cfg.tie_word_embeddings:
+            return (dec_out * (cfg.d_model ** -0.5)) @ shared.astype(jnp.float32).T
+        return dec_out @ params["lm_head"]["kernel"].astype(jnp.float32)
+
+    return forward
+
+
 def seq2seq_loss_fn(model, batch) -> jax.Array:
     """Padded-token-masked CE over decoder targets. Batch keys: input_ids,
     decoder_input_ids, labels (pad = -100, the HF convention)."""

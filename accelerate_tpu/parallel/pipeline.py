@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 def _pipeline_local(
     stage_fn: Callable,
     params: Any,  # this stage's param slice (leading stage dim consumed)
-    x_mb: jax.Array,  # [M, mb, ...] microbatched input, replicated across stages
+    x_mb: Any,  # [M, mb, ...]-leaved pytree, microbatched input, replicated across stages
     out_fn: Callable | None,
     out_fn_args: Any,
     out_fn_extra: Any,  # replicated pytree (e.g. head params) forwarded to out_fn
@@ -38,31 +38,37 @@ def _pipeline_local(
     r = jax.lax.axis_index(axis_name)
     # shard_map leaves a local leading stage dim of size 1 on the param slice
     params = jax.tree.map(lambda p: p[0], params)
-    M = x_mb.shape[0]
+    M = jax.tree.leaves(x_mb)[0].shape[0]
     T = M + S - 1
     ckpt_stage = jax.checkpoint(lambda p, x: stage_fn(p, x))
 
     def tick(carry, t):
         state = carry  # activation entering this stage this tick
         # stage 0 injects microbatch t (clamped; masked-out ticks produce garbage
-        # that never reaches an output row)
-        inj = x_mb[jnp.clip(t, 0, M - 1)]
-        state = jnp.where(r == 0, inj.astype(state.dtype), state)
+        # that never reaches an output row). The activation is a pytree (e.g.
+        # (x, encoder_out) for a T5 decoder stage), injected leaf-wise.
+        inj = jax.tree.map(lambda a: a[jnp.clip(t, 0, M - 1)], x_mb)
+        state = jax.tree.map(
+            lambda i, s: jnp.where(r == 0, i.astype(s.dtype), s), inj, state
+        )
         y = ckpt_stage(params, state)
         # pass activations along the ring; the wraparound (last -> 0) is ignored
         # because stage 0 overwrites with the next injection
-        y_next = jax.lax.ppermute(y, axis_name, [(i, (i + 1) % S) for i in range(S)])
+        y_next = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, [(i, (i + 1) % S) for i in range(S)]), y
+        )
         return y_next, y
 
-    state0 = jnp.zeros_like(stage_eval_shape(stage_fn, params, x_mb[0]))
+    state0 = stage_eval_shape(stage_fn, params, jax.tree.map(lambda a: a[0], x_mb))
     _, ys = jax.lax.scan(tick, state0, jnp.arange(T))  # ys: [T, mb, ...] per stage
 
     # microbatch m exits the last stage at tick m + S - 1
-    outs = ys[S - 1 :]  # [M, mb, ...] valid only on the last stage
+    outs = jax.tree.map(lambda a: a[S - 1 :], ys)  # [M, mb, ...] valid only on the last stage
     if out_fn is None:
         # replicate the last stage's outputs everywhere (scalar-free generic path)
-        mask = (r == S - 1).astype(outs.dtype)
-        return jax.lax.psum(outs * mask, axis_name)
+        return jax.tree.map(
+            lambda o: jax.lax.psum(o * (r == S - 1).astype(o.dtype), axis_name), outs
+        )
     if out_fn_extra is None:
         losses = jax.vmap(lambda y, a: out_fn(y, a))(outs, out_fn_args)  # [M]
     else:
@@ -76,17 +82,18 @@ def _pipeline_local(
     return loss
 
 
-def stage_eval_shape(stage_fn: Callable, params: Any, x: jax.Array) -> jax.Array:
+def stage_eval_shape(stage_fn: Callable, params: Any, x: Any) -> Any:
     """Zero-cost shape probe of a stage's output (stages must be shape-preserving
-    pipelines over the same activation shape, the GPipe contract)."""
-    shape = jax.eval_shape(stage_fn, params, x)
-    return jnp.zeros(shape.shape, shape.dtype)
+    pipelines over the same activation structure, the GPipe contract). Returns a
+    zeros pytree matching the stage output."""
+    shapes = jax.eval_shape(stage_fn, params, x)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def pipeline_apply(
     stage_fn: Callable,
     stacked_params: Any,  # pytree; every leaf has leading dim = num stages
-    x: jax.Array,  # global input [batch, ...]
+    x: Any,  # global input: array [batch, ...] or pytree of such
     mesh: Mesh,
     num_microbatches: int,
     out_fn: Callable | None = None,
@@ -94,11 +101,14 @@ def pipeline_apply(
     out_fn_extra: Any = None,
     axis_name: str = "stage",
     data_axis: str | None = "data",
-) -> jax.Array:
+) -> Any:
     """Run a stage-sharded model as a GPipe pipeline under jit.
 
     ``stage_fn(stage_params, x_mb) -> y_mb`` is one stage's forward on one
-    microbatch. With ``out_fn(y_mb, args_mb) -> scalar`` given, returns the mean
+    microbatch. The activation may be an arbitrary pytree as long as every stage
+    preserves its structure — e.g. ``(hidden, encoder_out)`` for a T5 decoder
+    stage, where ``encoder_out`` rides through unchanged. With
+    ``out_fn(y_mb, args_mb) -> scalar`` given, returns the mean
     loss (computed on the last stage, psum-broadcast); otherwise returns the
     stacked outputs [batch, ...]. ``out_fn_extra`` is an optional replicated
     pytree (e.g. LM-head parameters) passed as a third argument to ``out_fn`` —
@@ -115,11 +125,11 @@ def pipeline_apply(
             f"mesh's {axis_name!r} axis size {S} — one param slice per stage "
             "(extra stages would be silently dropped, missing ones under-shard)."
         )
-    b = x.shape[0]
+    b = jax.tree.leaves(x)[0].shape[0]
     if b % num_microbatches:
         raise ValueError(f"batch {b} must divide into {num_microbatches} microbatches")
     mb = b // num_microbatches
-    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+    x_mb = jax.tree.map(lambda a: a.reshape(num_microbatches, mb, *a.shape[1:]), x)
     args_mb = None
     if out_fn_args is not None:
         args_mb = jax.tree.map(
@@ -162,7 +172,7 @@ def pipeline_apply(
         check_vma=False,
     )(stacked_params, x_mb, args_mb, out_fn_extra)
     if out_fn is None:
-        return result.reshape(b, *result.shape[2:])
+        return jax.tree.map(lambda a: a.reshape(b, *a.shape[2:]), result)
     return result
 
 

@@ -100,7 +100,12 @@ def _image_to_uint8_hwc(img: Any):
     if arr.shape[0] in (1, 3, 4) and arr.shape[2] not in (1, 3, 4):
         arr = np.moveaxis(arr, 0, -1)  # CHW -> HWC
     if arr.dtype != np.uint8:
-        arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+        if np.issubdtype(arr.dtype, np.integer):
+            # integer pixels are already 0-255 counts; squeezing them through
+            # the float [0,1] path would saturate everything >= 1 to white
+            arr = np.clip(arr, 0, 255).astype(np.uint8)
+        else:
+            arr = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
     return arr
 
 
@@ -168,7 +173,13 @@ class JSONLTracker(GeneralTracker):
         paths = {}
         for k, img in _expand_image_keys(values):
             safe = k.replace("/", "_")
-            out = os.path.join(media_dir, f"{safe}_{step if step is not None else 'x'}.npy")
+            # per-tracker sequence number: sanitized keys can collide ("a/b"
+            # and "a_b") and step=None repeats — the counter keeps every .npy
+            # unique so earlier jsonl rows never point at overwritten pixels
+            seq = self._media_seq = getattr(self, "_media_seq", 0) + 1
+            out = os.path.join(
+                media_dir, f"{safe}_{step if step is not None else 'x'}_{seq}.npy"
+            )
             np.save(out, img)
             paths[k] = out
         self.log({"_images": paths}, step=step)

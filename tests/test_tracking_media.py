@@ -39,6 +39,13 @@ class TestImageNormalization:
         with pytest.raises(ValueError, match="HW or HWC"):
             _image_to_uint8_hwc(np.zeros((2, 2, 2, 2, 2)))
 
+    def test_integer_pixels_kept_not_saturated(self):
+        """int32/int64 pixels in 0-255 must pass through as counts — the float
+        [0,1] path would saturate everything >= 1 to 255."""
+        img = np.array([[[0], [128], [255]]], dtype=np.int32)  # 1x3x1 HWC
+        out = _image_to_uint8_hwc(img)
+        np.testing.assert_array_equal(out[..., 0], [[0, 128, 255]])
+
 
 class TestTableRows:
     def test_columns_and_data(self):
@@ -70,6 +77,24 @@ class TestJSONLMedia:
         saved = np.load(row["_images"]["viz/heat"])
         assert saved.dtype == np.uint8 and saved.shape == (4, 4, 1)
         assert saved.max() == 63  # 0.25 * 255
+
+    def test_log_images_colliding_keys_stay_distinct(self, tmp_path):
+        """'a/b' and 'a_b' sanitize identically, and step=None repeats — the
+        sequence suffix must keep every .npy unique so earlier rows never point
+        at overwritten pixels."""
+        t = JSONLTracker("run", logging_dir=str(tmp_path))
+        one = np.full((2, 2), 0.0, np.float32)
+        two = np.full((2, 2), 1.0, np.float32)
+        t.log_images({"a/b": one, "a_b": two})
+        t.log_images({"a/b": two})
+        t.finish()
+        rows = [json.loads(l) for l in open(tmp_path / "run.metrics.jsonl")]
+        img_rows = [r for r in rows if "_images" in r]
+        paths = [p for r in img_rows for p in r["_images"].values()]
+        assert len(set(paths)) == 3
+        assert np.load(img_rows[0]["_images"]["a/b"]).max() == 0
+        assert np.load(img_rows[0]["_images"]["a_b"]).max() == 255
+        assert np.load(img_rows[1]["_images"]["a/b"]).max() == 255
 
     def test_log_table_roundtrip(self, tmp_path):
         t = JSONLTracker("run", logging_dir=str(tmp_path))

@@ -133,3 +133,35 @@ def test_join_uneven_inputs_warns_without_loaders():
     with pytest.warns(UserWarning, match="no prepared dataloaders"):
         with acc.join_uneven_inputs([object()], even_batches=False):
             pass
+
+
+def test_join_uneven_inputs_skips_batch_size_less_sampler():
+    """even_batches=True cannot be forced onto a shard sampler with no declared
+    batch_size (the BatchSamplerShard constructor invariant): the override
+    must skip it with a warning, not crash the trailing-group refill."""
+    from accelerate_tpu.data_loader import BatchSamplerShard
+
+    class RaggedBatchSampler:
+        # yields hand-built batches; exposes NO batch_size attribute
+        def __iter__(self):
+            yield from ([0, 1, 2], [3, 4], [5, 6, 7], [8])
+
+        def __len__(self):
+            return 4
+
+    acc = _fresh()
+    shard = BatchSamplerShard(
+        RaggedBatchSampler(), num_processes=2, process_index=0, even_batches=False
+    )
+    assert shard.batch_size is None
+
+    class FakeLoader:  # the prepared-loader shape join_uneven_inputs walks
+        even_batches = False
+        batch_sampler = shard
+
+    acc._dataloaders.append(FakeLoader())
+    with pytest.warns(UserWarning, match="no batch_size"):
+        with acc.join_uneven_inputs([object()], even_batches=True):
+            assert not shard.even_batches  # override skipped, not applied
+            list(shard)  # refill must not run with an undefined pad target
+    assert not shard.even_batches

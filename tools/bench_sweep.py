@@ -6,9 +6,10 @@ those rules: a short-timeout probe before every run, >=90 s settle between
 runs, a cool-down wait after any failure, and one JSON line per config
 appended to the output file so a later wedge can't lose earlier results.
 
-Usage: python tools/bench_sweep.py [out.jsonl]
-Configs come from SWEEP below; edit freely — each entry is the env overlay
-for one `python bench.py` run.
+Usage: python tools/bench_sweep.py [out.jsonl] [configs.json]
+Configs come from SWEEP below (or a JSON list of env-overlay dicts passed as
+the second argument — used to resume an interrupted sweep with only the
+unmeasured rows); each entry is the env overlay for one `python bench.py` run.
 """
 
 from __future__ import annotations
@@ -58,7 +59,11 @@ def probe() -> bool:
 
 def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench_sweep.jsonl"
-    for i, overlay in enumerate(SWEEP):
+    sweep = SWEEP
+    if len(sys.argv) > 2:
+        with open(sys.argv[2]) as f:
+            sweep = json.load(f)
+    for i, overlay in enumerate(sweep):
         label = json.dumps(overlay, sort_keys=True)
         if not probe():
             print(f"[sweep] relay unreachable before config {label}; "
@@ -77,7 +82,7 @@ def main() -> None:
         # each row measures exactly its labeled config
         env["BENCH_NO_OVERLAY"] = "1"
         env.update(overlay)
-        print(f"[sweep] run {i + 1}/{len(SWEEP)}: {label}", flush=True)
+        print(f"[sweep] run {i + 1}/{len(sweep)}: {label}", flush=True)
         bench_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
         try:
             run = subprocess.run(
