@@ -1,0 +1,45 @@
+"""Prompt-sharded batch text generation (reference
+`examples/inference/distributed/phi2.py` role): each process takes its
+`split_between_processes` slice of the prompt list, decodes with the jitted
+KV-cache generate loop, and `gather_object` reassembles every completion
+everywhere. Swap the toy GPT-2 for real weights via `params_from_hf_gpt2`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.state import PartialState
+from accelerate_tpu.utils.operations import gather_object
+
+
+def main():
+    state = PartialState()
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0), batch=1, seq=16)
+
+    # 5 prompts over N processes: uneven split handled by split_between_processes
+    prompts = [
+        np.asarray([[2, 3, 5, 7, 11, 13, 17, 19]], np.int32),
+        np.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32),
+        np.asarray([[42, 42, 42, 42, 42, 42, 42, 42]], np.int32),
+        np.asarray([[9, 8, 7, 6, 5, 4, 3, 2]], np.int32),
+        np.asarray([[100, 101, 102, 103, 104, 105, 106, 107]], np.int32),
+    ]
+
+    completions = []
+    with state.split_between_processes(prompts) as my_prompts:
+        for ids in my_prompts:
+            out = generate(module, params, jnp.asarray(ids), max_new_tokens=8)
+            completions.append(np.asarray(out)[0].tolist())
+
+    all_completions = gather_object(completions)
+    if state.is_main_process:
+        for i, toks in enumerate(all_completions):
+            print(f"prompt {i}: +{toks}")
+
+
+if __name__ == "__main__":
+    main()

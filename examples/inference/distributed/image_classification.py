@@ -1,0 +1,39 @@
+"""Image-sharded batch classification (reference
+`examples/inference/distributed/distributed_image_generation.py` /
+`stable_diffusion.py` role, classification in place of diffusion): the image
+batch splits across processes with padding so every process runs the same
+static shape, each process runs ViT on its slice, predictions gather
+everywhere and the padding is dropped."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu.models.vit import ViTConfig, ViTForImageClassification
+from accelerate_tpu.state import PartialState
+from accelerate_tpu.utils.operations import gather_object
+
+
+def main():
+    state = PartialState()
+    cfg = ViTConfig.tiny()
+    module = ViTForImageClassification(cfg)
+    params = module.init_params(jax.random.key(0))
+
+    n_images = 10  # deliberately uneven for multi-process runs
+    images = np.random.default_rng(0).normal(  # NCHW, the torch conv layout
+        size=(n_images, cfg.num_channels, cfg.image_size, cfg.image_size)
+    ).astype(np.float32)
+
+    with state.split_between_processes(images, apply_padding=True) as my_images:
+        logits = module.apply({"params": params}, jnp.asarray(my_images))
+        preds = np.asarray(jnp.argmax(logits, axis=-1)).tolist()
+
+    all_preds = gather_object(preds)[:n_images]  # drop the padding tail
+    if state.is_main_process:
+        print(f"{len(all_preds)} predictions from {state.num_processes} process(es):")
+        print(all_preds)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""BERT pipeline-parallel inference (reference `examples/inference/pippy/bert.py`
+role): an encoder pipeline whose last stage output feeds a non-LM head
+(pooler + classifier). Pad-free batches — the PP path does not thread an
+attention mask (same as the reference's traced example inputs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu import prepare_pippy
+from accelerate_tpu.models.bert import (
+    BertConfig,
+    BertForSequenceClassification,
+    bert_blockwise,
+    bert_blockwise_state_dict,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig, build_mesh
+
+
+def main():
+    cfg = BertConfig.tiny(num_layers=4, dtype=jnp.float32)
+    module = BertForSequenceClassification(cfg)
+    params = module.init_params(jax.random.key(0))
+
+    mesh = build_mesh(ParallelismConfig(data_parallel_size=2, stage_size=4))
+    forward = prepare_pippy(bert_blockwise(cfg), bert_blockwise_state_dict(params), mesh=mesh)
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 32)), jnp.int32)
+    logits = forward(ids)  # [8, num_labels]
+    print(f"stages={forward.num_stages} class logits={logits.shape}")
+    print("predictions:", np.asarray(jnp.argmax(logits, axis=-1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Llama pipeline-parallel inference (reference `examples/inference/pippy/llama.py`
+role): the modern decoder stack (RMSNorm, RoPE, GQA, SwiGLU) through the same
+blockwise -> prepare_pippy API as GPT-2. For real weights, map a HF checkpoint
+with `params_from_hf_llama` or load safetensors shards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu import prepare_pippy
+from accelerate_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_blockwise,
+    llama_blockwise_state_dict,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig, build_mesh
+
+
+def main():
+    cfg = LlamaConfig.tiny(num_layers=4, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    params = module.init_params(jax.random.key(0))
+
+    mesh = build_mesh(ParallelismConfig(data_parallel_size=2, stage_size=4))
+    forward = prepare_pippy(
+        llama_blockwise(cfg), llama_blockwise_state_dict(params), mesh=mesh
+    )
+
+    prompts = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    logits = forward(prompts)
+    print(f"stages={forward.num_stages} logits={logits.shape}")
+    print("greedy next tokens:", np.asarray(jnp.argmax(logits[:, -1], axis=-1)))
+
+
+if __name__ == "__main__":
+    main()
