@@ -69,6 +69,21 @@ def _sagemaker_env_to_contract() -> None:
     os.environ["ACCELERATE_TPU_NUM_PROCESSES"] = str(len(hosts))
 
 
+def _in_multitask_slurm_step() -> bool:
+    """True inside an `srun` task of a multi-task SLURM step (the only case
+    where distributed init is needed and autodetectable). Discriminates on the
+    STEP task count, not the allocation's: a plain `sbatch --ntasks=N` batch
+    script also exports SLURM_NTASKS=N and SLURM_PROCID=0, but its single
+    batch-step process would block forever waiting for N-1 peers."""
+    if "SLURM_PROCID" not in os.environ or "SLURM_JOB_ID" not in os.environ:
+        return False
+    try:
+        step_tasks = int(os.environ.get("SLURM_STEP_NUM_TASKS") or 1)
+    except ValueError:
+        return False
+    return step_tasks > 1
+
+
 def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
     """Initialize jax.distributed from the launcher env contract if present.
 
@@ -83,6 +98,25 @@ def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("ACCELERATE_TPU_NUM_PROCESSES")
     if coord is None and nproc is None:
+        if _in_multitask_slurm_step():
+            # SLURM job step (reference: examples/slurm submit scripts feed
+            # torch.distributed via MASTER_ADDR; here jax's built-in cluster
+            # detection resolves coordinator/num_processes/process_id from the
+            # SLURM_* env directly — no launcher arguments needed)
+            if not jax.distributed.is_initialized():
+                extra: dict[str, Any] = {}
+                if initialization_timeout is not None:
+                    extra["initialization_timeout"] = int(initialization_timeout)
+                try:
+                    jax.distributed.initialize(**extra)
+                except (RuntimeError, ValueError) as e:
+                    # the user explicitly ran a multi-task srun step; falling
+                    # back to N duplicate single-process runs is NOT benign
+                    logger.warning(
+                        "multi-task SLURM step detected but "
+                        "jax.distributed.initialize failed (%s); each task now "
+                        "runs as an independent single-process world", e,
+                    )
         return
     # NOTE: must not touch jax.devices()/process_count() here — that would
     # initialize the backend single-process and make distributed init impossible

@@ -34,7 +34,7 @@ def main():
     if cfg.stage_size > 1 and dp != -1:
         dp = dp * cfg.stage_size
     acc = Accelerator(
-        mixed_precision=cfg.mixed_precision if cfg.mixed_precision != "fp8" else "bf16",
+        mixed_precision=cfg.mixed_precision,
         parallelism_config=ParallelismConfig(
             data_parallel_size=dp,
             fsdp_size=cfg.fsdp_size,

@@ -25,11 +25,16 @@ def main():
         size=(n_images, cfg.num_channels, cfg.image_size, cfg.image_size)
     ).astype(np.float32)
 
+    # padding gives every process the same static shape to jit; each process
+    # truncates its OWN padded tail before the gather (split_between_processes
+    # pads every process with index >= n % num_processes, not just the last)
+    base, extra = divmod(n_images, state.num_processes)
+    my_real = base + (1 if state.process_index < extra else 0)
     with state.split_between_processes(images, apply_padding=True) as my_images:
         logits = module.apply({"params": params}, jnp.asarray(my_images))
-        preds = np.asarray(jnp.argmax(logits, axis=-1)).tolist()
+        preds = np.asarray(jnp.argmax(logits, axis=-1)).tolist()[:my_real]
 
-    all_preds = gather_object(preds)[:n_images]  # drop the padding tail
+    all_preds = gather_object(preds)
     if state.is_main_process:
         print(f"{len(all_preds)} predictions from {state.num_processes} process(es):")
         print(all_preds)

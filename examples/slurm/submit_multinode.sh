@@ -1,0 +1,13 @@
+#!/bin/bash
+#SBATCH --job-name=accelerate-tpu-multinode
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=32
+#SBATCH --time=02:00:00
+#SBATCH --output=%x_%j.out
+
+# One process per node; jax.distributed self-configures from the SLURM step
+# (accelerate_tpu.state autodetects SLURM_NTASKS > 1 — no MASTER_ADDR plumbing).
+export ACCELERATE_TPU_MIXED_PRECISION=bf16
+
+srun python examples/complete_nlp_example.py --mixed_precision bf16

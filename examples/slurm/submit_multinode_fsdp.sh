@@ -1,0 +1,14 @@
+#!/bin/bash
+#SBATCH --job-name=accelerate-tpu-fsdp
+#SBATCH --nodes=4
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=32
+#SBATCH --time=02:00:00
+#SBATCH --output=%x_%j.out
+
+# Parameter + optimizer-state sharding over every device in the job: the fsdp
+# mesh axis absorbs all chips. Env contract: dp,fsdp,stage,sequence,tensor.
+export ACCELERATE_TPU_MIXED_PRECISION=bf16
+export ACCELERATE_TPU_PARALLELISM=1,-1,1,1,1
+
+srun python examples/by_feature/fsdp_with_peak_mem_tracking.py

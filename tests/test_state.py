@@ -101,6 +101,46 @@ def test_sagemaker_env_translates_to_jax_contract(monkeypatch):
     assert os.environ["JAX_PROCESS_ID"] == "1"  # sorted order
 
 
+def test_slurm_step_autodetects_distributed(monkeypatch):
+    """Inside a multi-task srun step (reference examples/slurm submit scripts
+    role) distributed init must fall through to jax's SLURM cluster detection:
+    initialize() called with NO explicit coordinator arguments."""
+    from accelerate_tpu import state as st
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "ACCELERATE_TPU_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_JOB_ID", "4242")
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "4")
+    calls = []
+    monkeypatch.setattr(st.jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(st.jax.distributed, "is_initialized", lambda: False)
+    st._maybe_init_distributed(initialization_timeout=60)
+    assert calls == [{"initialization_timeout": 60}]
+
+
+def test_sbatch_batch_step_stays_local(monkeypatch):
+    """A plain sbatch batch script (no srun) exports SLURM_NTASKS=N with a
+    single-task batch step — it must NOT attempt distributed init (it would
+    block waiting for peers that never start). The discriminator is the STEP
+    task count."""
+    from accelerate_tpu import state as st
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "ACCELERATE_TPU_NUM_PROCESSES", "SLURM_STEP_NUM_TASKS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_JOB_ID", "4242")
+    monkeypatch.setenv("SLURM_PROCID", "0")
+    monkeypatch.setenv("SLURM_NTASKS", "4")  # the allocation, not the step
+    calls = []
+    monkeypatch.setattr(st.jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    st._maybe_init_distributed()
+    assert calls == []
+
+
 def test_sagemaker_env_noop_outside_sagemaker(monkeypatch):
     from accelerate_tpu.state import _sagemaker_env_to_contract
 
