@@ -39,6 +39,7 @@ EXCLUDED = {
     "sliding_window_long_context.py": "model-architecture feature",
     "pipeline_parallel_training.py": "stage-mesh GPipe training is topology-specific",
     "tensor_parallel_gpt_pretraining.py": "TP mesh pretraining is topology-specific",
+    "moe_expert_parallel.py": "EP mesh + MoE architecture are topology-specific",
 }
 
 # Noise filter: API calls every script shares with the base workload by
