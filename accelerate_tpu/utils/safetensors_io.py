@@ -188,8 +188,18 @@ def load_safetensors_checkpoint(
         files = found
 
     flat: dict[str, Any] = {}
+    if len(files) > 1:
+        # shard reads are IO-bound memcpys that release the GIL: loading the
+        # shards concurrently overlaps disk/page-cache reads (reference
+        # load-time table is the benchmark this feeds — BASELINE.md)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(files), 8)) as pool:
+            for part in pool.map(lambda f: _load_one(f, dtype), files):
+                flat.update(part)
+    else:
+        flat.update(_load_one(files[0], dtype))
     for f in files:
-        flat.update(_load_one(f, dtype))
         if not tied:
             meta = _read_metadata(f)
             if "tied_weights" in meta:

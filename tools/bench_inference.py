@@ -100,9 +100,16 @@ def main() -> None:
         params = jax.tree.map(jax.device_put, params)
         module = QuantizedModule(module)
     else:
-        params = jax.tree.map(
-            lambda a: jax.device_put(jnp.asarray(a, dtype=cfg.param_dtype)), host_params
+        # transfer the checkpoint's fp16 bytes as-is and cast ON DEVICE: the
+        # host-side ml_dtypes fp16->bf16 conversion is single-threaded and
+        # would serialize ~params_b GB through one core; donation lets XLA
+        # alias the same-byte-width buffers so peak HBM stays ~one copy
+        params = jax.tree.map(jax.device_put, host_params)
+        cast = jax.jit(
+            lambda t: jax.tree.map(lambda x: x.astype(cfg.param_dtype), t),
+            donate_argnums=0,
         )
+        params = cast(params)
     jax.block_until_ready(params)
     load_s = time.perf_counter() - t0
     del host_params

@@ -4,13 +4,15 @@ bench program when the relay answers.
 Runs as the SINGLE device-touching process while the relay is wedged — a
 timed-out probe is itself a mid-op kill, so more frequent probing keeps the
 relay wedged (docs/PERF_NOTES.md round-3 addendum). On a successful probe it
-runs one hardware window: sweep -> winner promotion -> inference fp16/nf4
-pair -> nf4 kernel micro. Completed phases are remembered, so a window lost
-to a mid-program re-wedge resumes at the NEXT unfinished phase in a later
-window (up to MAX_WINDOWS attempts); the process exits once the full program
-has completed, or after the attempt cap.
+runs one hardware window: sweep -> winner promotion -> profile of the winner
+-> inference fp16/nf4 pair -> nf4 kernel micro. Completed phases are
+remembered, so a window lost to a mid-program re-wedge resumes at the NEXT
+unfinished phase in a later window (up to MAX_WINDOWS attempts); the process
+exits once the full program has completed, or after the attempt cap.
 
-Usage: python tools/relay_watch.py [sweep_out.jsonl]
+Usage: python tools/relay_watch.py [sweep_out.jsonl] [first_probe_delay_s]
+The optional delay defers the FIRST probe so a watcher restart keeps the
+at-most-hourly cadence relative to the previous process's last probe.
 """
 
 from __future__ import annotations
@@ -103,6 +105,12 @@ def _promote_winner(out_path: str, root: str, start_offset: int = 0) -> None:
 
 def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "SWEEP.jsonl"
+    # optional: sleep before the FIRST probe, so a watcher restart does not
+    # break the at-most-hourly probe cadence against a wedged relay
+    if len(sys.argv) > 2:
+        delay = int(sys.argv[2])
+        print(f"[watch] sleeping {delay}s before first probe", flush=True)
+        time.sleep(delay)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     done: set[str] = set()  # completed phases survive lost windows
     attempt = windows = 0
@@ -124,7 +132,7 @@ def main() -> None:
 
 def _run_window(out_path: str, root: str, done: set[str]) -> bool:
     """One hardware window, resuming at the first phase not in ``done``:
-    sweep -> promote -> inference pair -> nf4 micro. Returns True when the
+    sweep -> promote -> profile -> inference pair -> nf4 micro. Returns True when the
     full program has completed, False when the relay re-wedged partway
     (partial results are already on disk either way)."""
     time.sleep(SETTLE_S)
@@ -174,6 +182,36 @@ def _run_window(out_path: str, root: str, done: set[str]) -> bool:
             print("[watch] relay re-wedged after errored bench; pausing window", flush=True)
             return False
         done.add(phase)
+    if "profile" not in done:
+        # profile the promoted winner: per-op self-times for PERF_NOTES
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root
+        try:
+            with open(os.path.join(root, "BENCH_BEST.json")) as f:
+                for k, v in (json.load(f).get("config") or {}).items():
+                    env.setdefault(k, str(v))
+        except (OSError, ValueError):
+            pass
+        print(f"[watch] profiling winner (BENCH_MODEL={env.get('BENCH_MODEL', 'small')})",
+              flush=True)
+        stdout, stderr_tail = _run_salvaging(
+            [sys.executable, os.path.join(root, "tools", "profile_step.py"),
+             "/tmp/prof_winner"], env,
+        )
+        ok = bool(stdout.strip())
+        try:
+            with open(os.path.join(root, "PROFILE_WINNER.json"), "w") as f:
+                f.write(stdout if ok else json.dumps(
+                    {"error": "no-output", "stderr": stderr_tail[:200]}))
+        except OSError as e:
+            print(f"[watch] could not write PROFILE_WINNER.json: {e}", flush=True)
+        time.sleep(SETTLE_S)
+        if not ok and not probe():
+            # same retry contract as the inference phases: a failed profile in
+            # a re-wedged window stays UNfinished so a later window retries it
+            print("[watch] relay re-wedged during profile; pausing window", flush=True)
+            return False
+        done.add("profile")
     # nf4 kernel-vs-XLA micro-timings: the go/no-go data for wiring the fused
     # dequant-matmul into the decode loop (docs/PERF_NOTES.md round-4 queue)
     print("[watch] nf4 kernel microbench", flush=True)
