@@ -48,6 +48,37 @@ class TestRoundTrip:
         fp4 = dequantize(quantize(w, QuantizationConfig(load_in_4bit=True, quant_type="fp4")), jnp.float32)
         assert float(jnp.mean((nf4 - w) ** 2)) < float(jnp.mean((fp4 - w) ** 2))
 
+    @pytest.mark.parametrize("kind", ["int8", "nf4", "fp4"])
+    def test_device_path_matches_host_path(self, kind):
+        """quantize(on_device=True) — the fused jit pass bench_inference uses
+        for accelerator loads — must produce the same payload/scales as the
+        host numpy path (up to equidistant-codebook ties, which dequantize to
+        equally-near values)."""
+        w = _weights(shape=(64, 96))
+        cfg = QuantizationConfig(
+            load_in_8bit=kind == "int8", load_in_4bit=kind != "int8",
+            quant_type=kind if kind != "int8" else "nf4", min_weight_size=1,
+        )
+        host_qt = quantize(w, cfg)
+        dev_qt = quantize(jnp.asarray(w), cfg, on_device=True)
+        np.testing.assert_allclose(
+            np.asarray(host_qt.scales), np.asarray(dev_qt.scales), rtol=1e-6
+        )
+        host_back = np.asarray(dequantize(host_qt, jnp.float32))
+        dev_back = np.asarray(dequantize(dev_qt, jnp.float32))
+        # elementwise: both picks must be equally near the original
+        np.testing.assert_allclose(
+            np.abs(host_back - np.asarray(w)), np.abs(dev_back - np.asarray(w)),
+            rtol=1e-5, atol=1e-7,
+        )
+
+    def test_device_path_odd_size_pads(self):
+        w = _weights(shape=(33, 97))
+        cfg = QuantizationConfig(load_in_4bit=True, min_weight_size=1)
+        back = dequantize(quantize(jnp.asarray(w), cfg, on_device=True), jnp.float32)
+        assert back.shape == w.shape
+        assert float(jnp.abs(back - w).max() / jnp.abs(w).max()) < 0.2
+
     def test_odd_sizes_pad_correctly(self):
         w = _weights(shape=(33, 97))  # not a multiple of block_size
         cfg = QuantizationConfig(load_in_4bit=True, min_weight_size=1)
