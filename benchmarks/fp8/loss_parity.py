@@ -1,0 +1,89 @@
+"""FP8 loss-curve parity benchmark (reference `benchmarks/fp8/*` role): train
+the same model on the same data order twice — full precision vs the fp8
+recipe — and assert the loss trajectories stay within tolerance. Validates
+correctness of the fp8 integration, not speed (speed rows live in SWEEP.jsonl
+via BENCH_FP8).
+
+Topologies mirror the reference's scripts: single (non_distributed.py), dp
+(ddp.py), fsdp (fsdp.py). `--optimizer fp8` additionally swaps in the
+MS-AMP-O2-role `adamw_fp8` (e4m3 mu / scaled-fp16 nu) — the ms_amp suite's
+role. Prints one JSON line with both loss curves and the max divergence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, DataLoaderShard
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead, gpt2_sharding_rules, lm_loss_fn
+from accelerate_tpu.ops.fp8 import DelayedScalingRecipe, adamw_fp8
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def run(fp8: bool, topology: str, optimizer: str, steps: int) -> list[float]:
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    n = len(jax.devices())
+    pconf = {
+        "single": ParallelismConfig(data_parallel_size=-1),
+        "dp": ParallelismConfig(data_parallel_size=-1),
+        "fsdp": ParallelismConfig(data_parallel_size=1, fsdp_size=n),
+    }[topology]
+    acc = Accelerator(parallelism_config=pconf, sharding_rules=gpt2_sharding_rules())
+    cfg = GPT2Config.tiny(
+        dtype=jnp.float32,
+        fp8_recipe=DelayedScalingRecipe(amax_history_len=4) if fp8 else None,
+    )
+    module = GPT2LMHead(cfg)
+    variables = module.init_params(jax.random.key(0), batch=2, seq=32)
+    tx = adamw_fp8(1e-3, opt_level="O2") if (fp8 and optimizer == "fp8") else optax.adamw(1e-3)
+    model, opt = acc.prepare((module, variables), tx)
+    step = acc.make_train_step(lm_loss_fn)
+    rng = np.random.default_rng(0)  # IDENTICAL data order in both runs
+    # two fixed batches repeated: the model memorizes them, so the loss must
+    # fall visibly (random fresh tokens would leave the decrease in the noise)
+    uniq = [
+        {"input_ids": rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)}
+        for _ in range(2)
+    ]
+    batches = [uniq[i % 2] for i in range(steps)]
+    dl = acc.prepare(DataLoaderShard(batches))
+    return [round(float(step(b)), 4) for b in dl]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", choices=["single", "dp", "fsdp"], default="single")
+    ap.add_argument("--optimizer", choices=["adamw", "fp8"], default="adamw")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="max |fp8 - baseline| allowed at any step (loss units)")
+    args = ap.parse_args()
+
+    base = run(False, args.topology, args.optimizer, args.steps)
+    fp8 = run(True, args.topology, args.optimizer, args.steps)
+    div = max(abs(a - b) for a, b in zip(base, fp8))
+    ok = div <= args.tolerance and fp8[-1] < fp8[0]
+    print(json.dumps({
+        "metric": "fp8_loss_parity",
+        "topology": args.topology,
+        "optimizer": args.optimizer,
+        "baseline_loss": base,
+        "fp8_loss": fp8,
+        "max_divergence": round(div, 4),
+        "tolerance": args.tolerance,
+        "ok": ok,
+    }))
+    if not ok:
+        raise SystemExit(f"fp8 diverged from baseline: {div} > {args.tolerance}")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,10 +80,13 @@ class TestRunSalvaging:
         assert err == "boom"
 
     def test_timeout_salvages_partial_stdout(self, relay_watch):
+        # timeout must outlast interpreter startup on a loaded single-core box
+        # (a too-tight value makes this flake whenever the suite runs alongside
+        # another compile) while staying far below the child's sleep
         out, err = relay_watch._run_salvaging(
             [sys.executable, "-u", "-c",
-             "import time; print('{\"saved\": 1}', flush=True); time.sleep(60)"],
-            dict(os.environ), timeout=3,
+             "import time; print('{\"saved\": 1}', flush=True); time.sleep(300)"],
+            dict(os.environ), timeout=20,
         )
         assert '{"saved": 1}' in out
         assert err == "bench-timeout"
