@@ -25,9 +25,11 @@ def main():
     forward = t5_pipeline_forward(cfg, params, mesh=mesh)
 
     rng = np.random.default_rng(0)
-    src = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
-    tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 8)), jnp.int32)
-    logits = forward(src, tgt)  # [4, 8, vocab]
+    # batch 8 over 4 microbatches -> microbatch 2, divisible by dp=2 so each
+    # data replica pipelines its own slice (no replicated-compute fallback)
+    src = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 32)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)), jnp.int32)
+    logits = forward(src, tgt)  # [8, 8, vocab]
     print(f"logits={logits.shape}")
     print("greedy next tokens:", np.asarray(jnp.argmax(logits[:, -1], axis=-1)))
 

@@ -251,6 +251,30 @@ def _run_window(out_path: str, root: str, done: set[str]) -> bool:
             f.write(json.dumps(rec) + "\n")
     done.add("nf4_micro")
     print(f"[watch] nf4 microbench rows: {len(rows)}", flush=True)
+    if "examples" not in done:
+        # BASELINE 'targets to measure': nlp_example samples/s/chip +
+        # cv_example images/s/chip (configs[0]/[1])
+        time.sleep(SETTLE_S)
+        print("[watch] example-workload throughput rows", flush=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root
+        stdout, stderr_tail = _run_salvaging(
+            [sys.executable, os.path.join(root, "tools", "bench_examples.py")], env,
+        )
+        rows = []
+        for ln in stdout.strip().splitlines():
+            try:
+                rows.append(json.loads(ln))
+            except ValueError:
+                continue
+        if not rows:
+            rows = [{"metric": "example_throughput", "error": "no-json",
+                     "stderr": stderr_tail[:200]}]
+        with open(out_path, "a") as f:
+            for rec in rows:
+                f.write(json.dumps(rec) + "\n")
+        done.add("examples")
+        print(f"[watch] example rows: {len(rows)}", flush=True)
     print("[watch] done", flush=True)
     return True
 
