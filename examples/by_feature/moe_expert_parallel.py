@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from _common import base_parser
+
 from accelerate_tpu import Accelerator, DataLoaderShard
 from accelerate_tpu.models.mixtral import (
     MixtralConfig,
@@ -26,9 +28,11 @@ from accelerate_tpu.parallel.mesh import ParallelismConfig
 
 
 def main():
+    args = base_parser(num_epochs=1).parse_args()
+    steps = 6 if args.tiny else 10 * args.num_epochs
     cfg = MixtralConfig.tiny(dtype=jnp.float32)
     module = MixtralForCausalLM(cfg)
-    params = module.init_params(jax.random.key(0), batch=2, seq=16)
+    params = module.init_params(jax.random.key(args.seed), batch=2, seq=16)
 
     # dp=2 x ep=4: expert-stacked [E, in, out] weights shard E over 'tensor'
     # (EP rides the TP axis); XLA inserts the token all-to-alls
@@ -37,15 +41,15 @@ def main():
         sharding_rules=mixtral_sharding_rules(),
     )
 
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(args.seed)
     batches = [
         {"input_ids": rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)}
         for _ in range(2)
-    ] * 10
+    ] * steps
     # "intermediates": {} asks prepare to thread the mutable collection the
     # router sows its aux loss into; mixtral_loss_fn adds it to the LM loss
     model, opt, dl = acc.prepare(
-        (module, {"params": params, "intermediates": {}}), optax.adam(1e-2),
+        (module, {"params": params, "intermediates": {}}), optax.adam(args.lr),
         DataLoaderShard(batches),
     )
     w1 = model.params["layer_0"]["moe"]["w1"]
