@@ -18,6 +18,8 @@ Env:
   BENCH_INF_QUANT    nf4 | fp4 | int8: weight-only quantized decode (the
                      reference's bnb rows) — packed payload in HBM, dequant
                      fused into the matmuls via QuantizedModule
+  BENCH_INF_KV       int8: blockwise-quantized KV cache (halves cache HBM;
+                     beyond the reference) — composes with BENCH_INF_QUANT
 
 The checkpoint is synthetic (zeros): load-time and s/token depend on bytes
 and shapes, not values, and zeros keep corpus creation fast. The reference's
@@ -46,17 +48,22 @@ def main() -> None:
         save_safetensors_checkpoint,
     )
 
+    kv = os.environ.get("BENCH_INF_KV", "")
+    if kv not in ("", "int8"):
+        raise SystemExit(f"BENCH_INF_KV must be int8 or unset, got {kv!r}")
+    kv_kw = {"kv_cache_dtype": jnp.int8} if kv == "int8" else {}
     if preset == "llama2_7b":
         # max positions capped so the KV cache fits one 16 GB chip beside the
         # 13.5 GB of bf16 weights
         cfg = LlamaConfig.llama2_7b(
-            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, max_position_embeddings=512
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, max_position_embeddings=512,
+            **kv_kw,
         )
     elif preset == "tiny":
         cfg = LlamaConfig(
             vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
             num_heads=4, num_kv_heads=4, max_position_embeddings=128,
-            dtype=jnp.float32, param_dtype=jnp.float32,
+            dtype=jnp.float32, param_dtype=jnp.float32, **kv_kw,
         )
     else:
         raise SystemExit(f"unknown BENCH_INF_PRESET {preset!r}")
@@ -137,6 +144,7 @@ def main() -> None:
         "detail": {
             "preset": preset,
             "quant": quant or "fp16",
+            "kv_cache": kv or "full",
             **(
                 {"packed_gb": round(quantized_nbytes(params) / 1e9, 3)}
                 if quant
