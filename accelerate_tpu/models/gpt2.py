@@ -51,6 +51,7 @@ class GPT2Config:
     remat_policy: str | None = None  # see utils/remat.py: full|dots|dots_no_batch
     scan_layers: bool = False
     attention_impl: str = "auto"  # 'xla' | 'flash' | 'auto'
+    kv_cache_dtype: Any = None  # None | jnp.int8 (see models/kv_cache.py)
     # fp8 projections (reference TE convert_model role): a DelayedScalingRecipe
     # switches every block Dense to ops/fp8.Fp8Dense (delayed-scaling fp8
     # matmuls; scaling state rides the mutable fp8_meta collection)
@@ -101,30 +102,22 @@ class SelfAttention(nn.Module):
         v = v.reshape(b, s, cfg.n_head, head_dim)
         if decode:
             # autoregressive KV cache (flax decode idiom): fixed n_positions-long
-            # buffers, new keys/values written at the running index
-            is_init = self.has_variable("cache", "cached_key")
+            # buffers, new keys/values written at the running index; optional
+            # int8 storage (models/kv_cache.py)
+            from .kv_cache import decode_cache_update
+
             max_len = cfg.n_positions
-            cached_k = self.variable(
-                "cache", "cached_key", jnp.zeros, (b, max_len, cfg.n_head, head_dim), k.dtype
+            k_all, v_all, idx, is_init = decode_cache_update(
+                self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype
             )
-            cached_v = self.variable(
-                "cache", "cached_value", jnp.zeros, (b, max_len, cfg.n_head, head_dim), v.dtype
-            )
-            cache_idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
             if is_init:
-                idx = cache_idx.value
-                k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
-                v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
-                cached_k.value = k_all
-                cached_v.value = v_all
-                cache_idx.value = idx + s
                 # query i (global pos idx+i) may attend cache slots <= idx+i
                 q_pos = idx + jnp.arange(s)[:, None]
                 kv_pos = jnp.arange(max_len)[None, :]
                 mask = kv_pos <= q_pos  # [s, max_len]
                 out = attention(q, k_all, v_all, causal=False, mask=mask, implementation="xla")
             else:
-                out = attention(q, k, v, causal=True, implementation="xla")
+                out = attention(q, k_all, v_all, causal=True, implementation="xla")
         elif cfg.attention_impl == "ring":
             # sequence-parallel exact attention over the mesh's ring axis
             from ..parallel.ring_attention import ring_attention_sharded

@@ -1,0 +1,82 @@
+"""Decode-time KV cache: the flax decode idiom (fixed-length buffers, running
+write index) shared by every autoregressive model in the zoo, with optional
+int8 blockwise storage (one fp32 absmax scale per (batch, position, kv-head) —
+halves cache HBM, the decode-attention bandwidth term; the dequantize fuses
+into the attention matmuls). Beyond the reference: its bnb integration
+quantizes weights only.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_cache_update(
+    mod: Any,  # the flax module (self) owning the "cache" collection
+    k: jax.Array,  # [b, s, kv_heads, head_dim] new keys
+    v: jax.Array,
+    max_len: int,
+    kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized
+) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
+    """Create/update the module's decode cache and return
+    ``(k_all, v_all, write_index, is_init)``.
+
+    ``k_all``/``v_all`` are the full ``[b, max_len, ...]`` buffers in compute
+    dtype (dequantized when stored int8) — on the first (shape-init) trace they
+    are just ``k``/``v`` and ``is_init`` is False. ``write_index`` is the cache
+    position the new entries were written at.
+    """
+    if kv_cache_dtype is not None and np.dtype(kv_cache_dtype) != np.dtype("int8"):
+        # fail fast with the cause named — an arbitrary dtype would surface as
+        # an obscure lax dtype-mismatch deep in the cache update
+        raise ValueError(
+            f"kv_cache_dtype supports None (compute dtype) or int8, got {kv_cache_dtype}"
+        )
+    quant = kv_cache_dtype is not None
+    b, s, kv_heads, head_dim = k.shape
+    store_dtype = jnp.int8 if quant else k.dtype
+    is_init = mod.has_variable("cache", "cached_key")
+    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
+                            (b, max_len, kv_heads, head_dim), store_dtype)
+    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
+                            (b, max_len, kv_heads, head_dim), store_dtype)
+    if quant:
+        k_scale = mod.variable("cache", "key_scale", jnp.zeros,
+                               (b, max_len, kv_heads), jnp.float32)
+        v_scale = mod.variable("cache", "value_scale", jnp.zeros,
+                               (b, max_len, kv_heads), jnp.float32)
+    cache_idx = mod.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+
+    if not is_init:
+        return k, v, cache_idx.value, False
+
+    def _q(x):
+        absmax = jnp.abs(x.astype(jnp.float32)).max(axis=-1)
+        scale = jnp.where(absmax > 0, absmax, 1.0) / 127.0
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def _dq(q, scale, dtype):
+        return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+    idx = cache_idx.value
+    if quant:
+        kq, ks = _q(k)
+        vq, vs = _q(v)
+        cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, kq, (0, idx, 0, 0))
+        cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, vq, (0, idx, 0, 0))
+        k_scale.value = jax.lax.dynamic_update_slice(k_scale.value, ks, (0, idx, 0))
+        v_scale.value = jax.lax.dynamic_update_slice(v_scale.value, vs, (0, idx, 0))
+        k_all = _dq(cached_k.value, k_scale.value, k.dtype)
+        v_all = _dq(cached_v.value, v_scale.value, v.dtype)
+    else:
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+        cached_k.value, cached_v.value = k_all, v_all
+    cache_idx.value = idx + s
+    return k_all, v_all, idx, True

@@ -127,58 +127,13 @@ class LlamaAttention(nn.Module):
         groups = cfg.num_heads // cfg.num_kv_heads
 
         if decode:
-            is_init = self.has_variable("cache", "cached_key")
+            from .kv_cache import decode_cache_update
+
             max_len = cfg.max_position_embeddings
-            if cfg.kv_cache_dtype is not None and np.dtype(cfg.kv_cache_dtype) != np.dtype("int8"):
-                # fail fast with the cause named — an arbitrary dtype would
-                # surface as an obscure lax dtype-mismatch deep in the cache
-                # update
-                raise ValueError(
-                    f"kv_cache_dtype supports None (compute dtype) or int8, got "
-                    f"{cfg.kv_cache_dtype}"
-                )
-            quant_cache = cfg.kv_cache_dtype is not None
-            store_dtype = jnp.int8 if quant_cache else k.dtype
-            cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                     (b, max_len, cfg.num_kv_heads, head_dim), store_dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                     (b, max_len, cfg.num_kv_heads, head_dim), store_dtype)
-            if quant_cache:
-                # absmax scale per (batch, position, kv-head): one fp32 per
-                # head_dim int8 values — the cache reads 1 byte/element + a
-                # 4-byte scale per head row, ~2x less HBM than bf16
-                k_scale = self.variable("cache", "key_scale", jnp.zeros,
-                                        (b, max_len, cfg.num_kv_heads), jnp.float32)
-                v_scale = self.variable("cache", "value_scale", jnp.zeros,
-                                        (b, max_len, cfg.num_kv_heads), jnp.float32)
-            cache_idx = self.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
-
-            def _q(x):
-                absmax = jnp.abs(x.astype(jnp.float32)).max(axis=-1)
-                scale = jnp.where(absmax > 0, absmax, 1.0) / 127.0
-                q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                             -127, 127).astype(jnp.int8)
-                return q, scale
-
-            def _dq(q, scale, dtype):
-                return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
-
+            k_all, v_all, idx, is_init = decode_cache_update(
+                self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype
+            )
             if is_init:
-                idx = cache_idx.value
-                if quant_cache:
-                    kq, ks = _q(k)
-                    vq, vs = _q(v)
-                    cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, kq, (0, idx, 0, 0))
-                    cached_v.value = jax.lax.dynamic_update_slice(cached_v.value, vq, (0, idx, 0, 0))
-                    k_scale.value = jax.lax.dynamic_update_slice(k_scale.value, ks, (0, idx, 0))
-                    v_scale.value = jax.lax.dynamic_update_slice(v_scale.value, vs, (0, idx, 0))
-                    k_all = _dq(cached_k.value, k_scale.value, k.dtype)
-                    v_all = _dq(cached_v.value, v_scale.value, v.dtype)
-                else:
-                    k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
-                    v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
-                    cached_k.value, cached_v.value = k_all, v_all
-                cache_idx.value = idx + s
                 q_pos = idx + jnp.arange(s)[:, None]
                 k_idx = jnp.arange(max_len)[None, :]
                 mask = k_idx <= q_pos
@@ -188,7 +143,7 @@ class LlamaAttention(nn.Module):
                 # of truth with the training branches
                 out = attention(q, k_all, v_all, causal=False, mask=mask, implementation="xla")
             else:
-                out = attention(q, k, v, causal=True, window=cfg.sliding_window,
+                out = attention(q, k_all, v_all, causal=True, window=cfg.sliding_window,
                                 implementation="xla")
         else:
             if cfg.attention_impl == "ring":

@@ -51,6 +51,7 @@ class MixtralConfig:
     remat_policy: str | None = None  # see utils/remat.py
     attention_impl: str = "auto"
     sliding_window: int | None = None  # HF MixtralConfig.sliding_window role
+    kv_cache_dtype: Any = None  # None | jnp.int8 (see LlamaConfig.kv_cache_dtype)
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
@@ -79,6 +80,7 @@ class MixtralConfig:
             remat=self.remat,
             attention_impl=self.attention_impl,
             sliding_window=self.sliding_window,
+            kv_cache_dtype=self.kv_cache_dtype,
         )
 
 

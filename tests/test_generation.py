@@ -29,6 +29,29 @@ def test_cached_generation_matches_nocache():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+def test_int8_cache_generation_tracks_exact_gpt2():
+    """The shared decode_cache_update gives GPT-2 the int8 cache too: greedy
+    rollout agrees with the exact-cache rollout on most positions."""
+    prompt = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 8)), dtype=jnp.int32)
+
+    def rollout(**kw):
+        cfg = GPT2Config.tiny(dtype=jnp.float32, **kw)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+        return np.asarray(generate(module, params, prompt, max_new_tokens=8, temperature=0.0))
+
+    exact = rollout()
+    quant = rollout(kv_cache_dtype=jnp.int8)
+    assert (exact == quant).mean() >= 0.5
+
+
+def test_mixtral_kv_cache_dtype_passthrough():
+    from accelerate_tpu.models.mixtral import MixtralConfig
+
+    lcfg = MixtralConfig.tiny(kv_cache_dtype=jnp.int8).as_llama()
+    assert lcfg.kv_cache_dtype == jnp.int8
+
+
 def test_sampled_generation_shape_and_determinism():
     cfg = GPT2Config.tiny(dtype=jnp.float32)
     module = GPT2LMHead(cfg)
