@@ -73,6 +73,11 @@ def generate(
 
     temperature=0 is greedy; otherwise categorical sampling (optionally top-k).
     Returns [batch, max_new_tokens] new tokens (prompt not repeated).
+
+    Contract: prompt rows share one length (the cache write index is global —
+    batch ragged prompts by bucketing equal lengths, as the distributed
+    inference examples do; the reference delegates generation to transformers
+    entirely, so there is no reference ragged-batch behavior to match).
     """
     if rng is None:
         rng = jax.random.key(0)
