@@ -57,22 +57,42 @@ def estimate_parameters(model_name: str) -> int:
 
 def estimate_command(args: argparse.Namespace) -> None:
     n = estimate_parameters(args.model_name)
+    # TPU-native extension over the reference tool: parameter-state sharding.
+    # fsdp shards params+grads+optimizer state; tensor shards params+grads
+    # (Megatron column/row splits); data replicates. Per-chip bytes divide by
+    # the sharding degree — the reference's per-GPU table has no analogue
+    # because torch DDP replicates everything.
+    shard = max(1, args.fsdp) * max(1, args.tensor)
     rows = []
     for dtype in args.dtypes:
         b = DTYPE_BYTES[dtype]
         params = n * b
         # training ~= params + grads + adam (2x fp32 moments) + master fp32 params
         train = params + n * b + 2 * n * 4 + (n * 4 if b < 4 else 0)
-        rows.append((dtype, _fmt(params), _fmt(train)))
+        rows.append((dtype, _fmt(params), _fmt(train),
+                     _fmt(params / shard), _fmt(train / shard)))
     w = max(len(r[1]) for r in rows) + 2
     print(f"Model: {args.model_name} — {n:,} parameters")
-    print(f"{'dtype':8} {'inference':>{w}} {'training (adam)':>{w+8}}")
-    for dtype, inf, train in rows:
-        print(f"{dtype:8} {inf:>{w}} {train:>{w+8}}")
+    header = f"{'dtype':8} {'inference':>{w}} {'training (adam)':>{w+8}}"
+    if shard > 1:
+        header += f" {'per-chip inf':>{w+4}} {'per-chip train':>{w+6}}"
+    print(header)
+    for dtype, inf, train, pinf, ptrain in rows:
+        line = f"{dtype:8} {inf:>{w}} {train:>{w+8}}"
+        if shard > 1:
+            line += f" {pinf:>{w+4}} {ptrain:>{w+6}}"
+        print(line)
+    if shard > 1:
+        print(f"(sharded over fsdp={args.fsdp} x tensor={args.tensor} = {shard} chips; "
+              "activations/KV cache not included)")
 
 
 def add_parser(subparsers) -> None:
     p = subparsers.add_parser("estimate-memory", help="estimate model memory usage")
     p.add_argument("model_name")
     p.add_argument("--dtypes", nargs="+", default=["float32", "bf16"], choices=list(DTYPE_BYTES))
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="fsdp-axis degree: divide param/grad/optimizer bytes per chip")
+    p.add_argument("--tensor", type=int, default=1,
+                   help="tensor-axis degree: divide param/grad bytes per chip")
     p.set_defaults(func=estimate_command)

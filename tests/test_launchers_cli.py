@@ -147,6 +147,14 @@ class TestCLI:
         assert out.returncode == 0
         assert "parameters" in out.stdout
 
+    def test_estimate_memory_sharded(self):
+        """--fsdp/--tensor divide the parameter-state bytes per chip (the
+        TPU-native extension over the reference's replicated-DDP table)."""
+        out = self._run("estimate-memory", "gpt2", "--dtypes", "bf16", "--fsdp", "8")
+        assert out.returncode == 0
+        assert "per-chip" in out.stdout
+        assert "fsdp=8" in out.stdout
+
     def test_tpu_config_dry_run(self):
         out = self._run(
             "tpu-config", "--tpu_name", "t", "--zone", "z", "--command", "echo hi", "--dry_run"
