@@ -178,9 +178,22 @@ def main() -> None:
             sys.exit(0)
 
     state["stage"] = "startup"
-    _arm_watchdog(_env_int("BENCH_TIMEOUT", 540), state)
+    # gpt2-medium's first compile is several minutes over the relay; give the
+    # watchdog headroom when the overlay promoted the bigger model
+    default_timeout = 780 if os.environ.get("BENCH_MODEL") == "medium" else 540
+    _arm_watchdog(_env_int("BENCH_TIMEOUT", default_timeout), state)
 
     import jax
+
+    # persistent compile cache: sweep runs earlier in the round warm it, so
+    # the driver's end-of-round run skips the multi-minute medium compile
+    # (set programmatically — jax is already imported by sitecustomize, so an
+    # os.environ write here would be too late)
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_bench_cache"))
+    except Exception:
+        pass  # older jax without the option: compile uncached
 
     if force_cpu:
         from accelerate_tpu.test_utils.platform import force_cpu_platform
