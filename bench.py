@@ -230,14 +230,16 @@ def main() -> None:
     # GPT-2 on one v5e chip; CPU fallback uses a tiny config so CI completes
     model_name = os.environ.get("BENCH_MODEL", "small")
     if on_tpu:
-        cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
-        cfg = cfg_cls(
-            dtype=jnp.bfloat16, attention_impl=attn, scan_layers=scan,
-            remat=bool(remat), remat_policy=remat or None, **fp8_model_kw,
-        )
         batch = _env_int("BENCH_BATCH", 8)
         seq = _env_int("BENCH_SEQ", 1024)
         iters = _env_int("BENCH_ITERS", 30)
+        cfg_cls = {"small": GPT2Config.small, "medium": GPT2Config.medium}[model_name]
+        cfg = cfg_cls(
+            dtype=jnp.bfloat16, attention_impl=attn, scan_layers=scan,
+            remat=bool(remat), remat_policy=remat or None,
+            # long-context rows need the learned position table to cover seq
+            n_positions=max(1024, seq), **fp8_model_kw,
+        )
     else:
         cfg = GPT2Config.tiny(dtype=jnp.float32, scan_layers=scan, **fp8_model_kw)
         batch = _env_int("BENCH_BATCH", 8)

@@ -40,6 +40,12 @@ SWEEP: list[dict[str, str]] = [
     {"BENCH_SCAN": "1"},
     {"BENCH_REMAT": "dots"},
     {"BENCH_FP8": "all", "BENCH_FUSED_CE": "2"},
+    # long-context rows: at s=4096 the causal-triangle grid's skipped blocks
+    # outweigh its per-cell overhead (the s=1024 rows measured the opposite —
+    # PERF_NOTES round-5); fused CE keeps the [b,s,V] fp32 logits out of HBM
+    {"BENCH_SEQ": "4096", "BENCH_BATCH": "2", "BENCH_FUSED_CE": "2"},
+    {"BENCH_SEQ": "4096", "BENCH_BATCH": "2", "BENCH_FUSED_CE": "2",
+     "ACCELERATE_TPU_FLASH_TRIANGLE": "512"},
 ]
 
 
