@@ -92,6 +92,57 @@ class TestRunSalvaging:
         assert err == "bench-timeout"
 
 
+class TestWindowPhases:
+    """_run_window's resume contract: a phase that fails while the relay
+    re-wedges stays UNfinished (retried next window); completed phases are
+    remembered. All device/bench calls stubbed; sleeps patched out."""
+
+    @pytest.fixture()
+    def fast(self, relay_watch, monkeypatch, tmp_path):
+        monkeypatch.setattr(relay_watch.time, "sleep", lambda s: None)
+        monkeypatch.setattr(relay_watch, "_prewarm_checkpoint_cache", lambda: None)
+        # sweep subprocess: appends nothing (configs already measured)
+        monkeypatch.setattr(relay_watch.subprocess, "run",
+                            lambda *a, **k: type("R", (), {"stdout": "", "stderr": ""})())
+        monkeypatch.setattr(relay_watch, "_promote_winner", lambda *a, **k: None)
+        out = tmp_path / "sweep.jsonl"
+        out.write_text("")
+        return relay_watch, str(out), str(tmp_path)
+
+    def test_profile_failure_in_wedged_window_is_retried(self, fast, monkeypatch):
+        rw, out, root = fast
+        calls = []
+
+        def salvage(cmd, env, timeout=1800):
+            calls.append(cmd[-2] if len(cmd) > 1 else cmd)
+            if "profile_step.py" in " ".join(cmd):
+                return "", "bench-timeout"  # profile produced nothing
+            return '{"metric": "x", "value": 1}', ""
+
+        monkeypatch.setattr(rw, "_run_salvaging", salvage)
+        monkeypatch.setattr(rw, "probe", lambda: False)  # relay re-wedged
+        monkeypatch.setattr(rw.os.path, "join", rw.os.path.join)
+        done = {"sweep", "inf_fp16", "inf_nf4"}
+        assert rw._run_window(out, root, done) is False
+        assert "profile" not in done  # stays unfinished -> retried next window
+
+    def test_profile_success_completes_window(self, fast, monkeypatch):
+        rw, out, root = fast
+
+        def salvage(cmd, env, timeout=1800):
+            return '{"metric": "x", "value": 1}', ""
+
+        monkeypatch.setattr(rw, "_run_salvaging", salvage)
+        monkeypatch.setattr(rw, "probe", lambda: True)
+        done = {"sweep"}
+        assert rw._run_window(out, root, done) is True
+        assert {"inf_fp16", "inf_nf4", "profile", "nf4_micro", "examples"} <= done
+        import json as _json
+
+        rows = [_json.loads(l) for l in open(out)]
+        assert rows, "phases should have appended rows"
+
+
 class TestBenchOverlay:
     @pytest.fixture(autouse=True)
     def _clean_overlay_env(self):
