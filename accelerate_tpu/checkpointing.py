@@ -298,9 +298,19 @@ def save_model_weights(
     `save_model`, `accelerator.py:2804-2919`), written by process 0:
     sharded ``.safetensors`` + index with tied-weight dedup by default, or flax
     msgpack with ``safe_serialization=False``. Counterpart of the sharded orbax
-    layout above."""
+    layout above.
+
+    Quantized (``QuantizedTensor``) leaves are dequantized to dense arrays on
+    export — the interchange format is dense weights, matching how quantized
+    models re-enter through ``quantize_params`` at load."""
     if not PartialState().is_main_process:
         return []
+    from .utils.quantization import QuantizedTensor, dequantize_params
+
+    if any(isinstance(l, QuantizedTensor)
+           for l in jax.tree.leaves(state_dict,
+                                    is_leaf=lambda l: isinstance(l, QuantizedTensor))):
+        state_dict = dequantize_params(state_dict)
     os.makedirs(save_directory, exist_ok=True)
     if safe_serialization:
         from .utils.safetensors_io import save_safetensors_checkpoint

@@ -235,3 +235,18 @@ class TestQuantizedGeneration:
         assert packed < dense / 3  # 4-bit payload + scales vs fp32
         assert any(isinstance(l, QuantizedTensor)
                    for l in jax.tree.leaves(qparams, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
+
+
+class TestQuantizedExport:
+    def test_save_model_weights_dequantizes_on_export(self, tmp_path):
+        """Exporting a quantized tree must produce a DENSE interchange
+        checkpoint (the obscure SafetensorError on QuantizedTensor leaves was
+        a real failure), round-tripping within 4-bit blockwise error."""
+        from accelerate_tpu.checkpointing import load_model_weights, save_model_weights
+
+        params = {"w": np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32)}
+        q = quantize_params(params, QuantizationConfig(load_in_4bit=True, min_weight_size=1))
+        save_model_weights(q, str(tmp_path))
+        back = load_model_weights(str(tmp_path))
+        err = np.abs(np.asarray(back["w"]) - params["w"]).max() / np.abs(params["w"]).max()
+        assert float(err) < 0.2
