@@ -210,6 +210,47 @@ def mixtral_sharding_rules() -> ShardingRules:
     )
 
 
+def mixtral_blockwise(config: MixtralConfig):
+    """Decompose Mixtral into sequential blocks (embed -> layer_i... -> head)
+    for blockwise offload streaming and `prepare_pippy` PP inference, like
+    `llama_blockwise`. The router's aux-loss sow is a no-op on this path
+    (no mutable 'intermediates' collection at inference)."""
+    from ..big_modeling import BlockwiseModel
+
+    def embed_fn(p, input_ids):
+        return p["embed_tokens"].astype(config.dtype)[input_ids]
+
+    def make_block_fn(i):
+        def block_fn(p, x):
+            return MixtralBlock(config, name=f"layer_{i}").apply({"params": p}, x)
+
+        return block_fn
+
+    def head_fn(p, x):
+        x = RMSNorm(config.rms_norm_eps, config.param_dtype, name="final_norm").apply(
+            {"params": p["final_norm"]}, x
+        )
+        return jnp.einsum(
+            "bse,ve->bsv", x.astype(config.dtype), p["lm_head"].astype(config.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    fns = [("embed", embed_fn)]
+    fns += [(f"layer_{i}", make_block_fn(i)) for i in range(config.num_layers)]
+    fns += [("head", head_fn)]
+    return BlockwiseModel(block_fns=fns)
+
+
+def mixtral_blockwise_state_dict(params: dict) -> dict:
+    """Regroup a MixtralForCausalLM param tree into the blockwise layout."""
+    out = {"embed": {"embed_tokens": params["embed_tokens"]}}
+    for k in params:
+        if k.startswith("layer_"):
+            out[k] = params[k]
+    out["head"] = {"final_norm": params["final_norm"], "lm_head": params["lm_head"]}
+    return out
+
+
 def mixtral_loss_fn(model, batch) -> jax.Array:
     """LM loss + sown router aux losses (must be added inside the grad fn)."""
     from ..ops.moe import collect_aux_losses

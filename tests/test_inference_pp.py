@@ -123,6 +123,28 @@ def test_pp_bert_matches_plain_forward():
     np.testing.assert_allclose(np.asarray(fwd(ids)), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+def test_pp_mixtral_matches_plain_forward():
+    """Mixtral blockwise: MoE trunk blocks pipeline like dense ones (the
+    router aux sow no-ops without a mutable collection)."""
+    from accelerate_tpu.models.mixtral import (
+        MixtralConfig,
+        MixtralForCausalLM,
+        mixtral_blockwise,
+        mixtral_blockwise_state_dict,
+    )
+
+    cfg = MixtralConfig.tiny(num_layers=4, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = MixtralForCausalLM(cfg)
+    params = module.init_params(jax.random.key(4))
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (4, 16)), dtype=jnp.int32
+    )
+    ref = module.apply({"params": params}, ids)
+    mesh = build_mesh(ParallelismConfig(data_parallel_size=2, stage_size=4))
+    fwd = prepare_pippy(mixtral_blockwise(cfg), mixtral_blockwise_state_dict(params), mesh=mesh)
+    np.testing.assert_allclose(np.asarray(fwd(ids)), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
 def test_pp_t5_both_stacks_match_plain_forward():
     """T5 encoder+decoder pipelines (reference pippy t5 example role): the
     decoder stage threads a PYTREE activation (hidden, encoder_out) — pins the
