@@ -205,6 +205,46 @@ def test_blockwise_dispatch_matches_full(tiny_gpt2, tmp_path, mode):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("family", ["llama", "bert", "mixtral"])
+def test_new_blockwise_families_offload_stream(family, tmp_path):
+    """The round-5 blockwise decompositions run the SAME offload-streaming
+    path as gpt2: mixed device/cpu/disk tiers, output parity with the
+    monolithic forward."""
+    import jax
+    import jax.numpy as jnp
+
+    if family == "llama":
+        from accelerate_tpu.models.llama import (
+            LlamaConfig, LlamaForCausalLM, llama_blockwise, llama_blockwise_state_dict)
+
+        cfg = LlamaConfig.tiny(num_layers=3, dtype=jnp.float32, param_dtype=jnp.float32)
+        module, bw_fn, sd_fn = LlamaForCausalLM(cfg), llama_blockwise, llama_blockwise_state_dict
+    elif family == "bert":
+        from accelerate_tpu.models.bert import (
+            BertConfig, BertForSequenceClassification, bert_blockwise, bert_blockwise_state_dict)
+
+        cfg = BertConfig.tiny(num_layers=3, dtype=jnp.float32)
+        module, bw_fn, sd_fn = (
+            BertForSequenceClassification(cfg), bert_blockwise, bert_blockwise_state_dict)
+    else:
+        from accelerate_tpu.models.mixtral import (
+            MixtralConfig, MixtralForCausalLM, mixtral_blockwise, mixtral_blockwise_state_dict)
+
+        cfg = MixtralConfig.tiny(num_layers=3, dtype=jnp.float32, param_dtype=jnp.float32)
+        module, bw_fn, sd_fn = MixtralForCausalLM(cfg), mixtral_blockwise, mixtral_blockwise_state_dict
+
+    params = module.init_params(jax.random.key(7))
+    ids = jnp.asarray(np.random.default_rng(7).integers(0, 200, (2, 12)), jnp.int32)
+    ref = module.apply({"params": params}, ids)
+    bw = bw_fn(cfg)
+    sd = sd_fn(params)
+    names = [n for n, _ in bw.block_fns]
+    dmap = {n: ("device" if i % 3 == 0 else "cpu" if i % 3 == 1 else "disk")
+            for i, n in enumerate(names)}
+    bw = dispatch_model(bw, dmap, sd, offload_dir=str(tmp_path / "offload"))
+    np.testing.assert_allclose(np.asarray(bw(ids)), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_split_block_device_map_dispatch(tiny_gpt2, tmp_path):
     """A solver-split block (nested device_map keys straddling tiers) must be
     assembled transparently by dispatch + BlockwiseModel, and the model must
