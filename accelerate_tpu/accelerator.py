@@ -103,7 +103,9 @@ class PreparedModel:
         self.module = module  # the original user object, for unwrap_model
         self.extra_state = extra_state  # mutable non-param collections (replicated)
         self._acc_grads = None  # used only when no optimizer is prepared
-        self._jit_forwards: dict[bool, Callable] = {}
+        # keyed by (autocast_enabled, sorted static flag kwargs) — one compiled
+        # forward per flag combination (see __call__)
+        self._jit_forwards: dict[tuple, Callable] = {}
         self._hook = None  # hooks.ModelHook attachment point
         self.training = True
 
@@ -168,23 +170,32 @@ class PreparedModel:
         from .utils.precision import autocast_enabled
 
         cast = autocast_enabled()  # False inside autocast(AutocastKwargs(enabled=False))
-        if cast not in self._jit_forwards:
-            policy = self.policy
-            has_state = self.extra_state is not None
-
-            def fwd(params, state, args, kwargs, _cast=cast):
-                p = policy.cast_to_compute(params) if _cast else params
-                if has_state:
-                    out, new_state = self.apply_fn(p, *args, extra_state=state, **kwargs)
-                else:
-                    out, new_state = self.apply_fn(p, *args, **kwargs), None
-                return (policy.cast_to_output(out) if _cast else out), new_state
-
-            self._jit_forwards[cast] = jax.jit(fwd)
         params = self.params
         if self._hook is not None:
             params, args, kwargs = self._hook.pre_forward(self, params, args, kwargs)
-        out, new_state = self._jit_forwards[cast](params, self.extra_state, args, kwargs)
+        # flag kwargs (deterministic=False, decode=True, return_hidden=True, …)
+        # are Python control flow, not data: tracing them raises
+        # TracerBoolConversionError inside the model. Route them around the jit
+        # as part of the compilation key instead.
+        static_kwargs = {
+            k: v for k, v in kwargs.items() if isinstance(v, (bool, str)) or v is None
+        }
+        traced_kwargs = {k: v for k, v in kwargs.items() if k not in static_kwargs}
+        key = (cast, tuple(sorted(static_kwargs.items())))
+        if key not in self._jit_forwards:
+            policy = self.policy
+            has_state = self.extra_state is not None
+
+            def fwd(params, state, args, kwargs, _cast=cast, _static=dict(static_kwargs)):
+                p = policy.cast_to_compute(params) if _cast else params
+                if has_state:
+                    out, new_state = self.apply_fn(p, *args, extra_state=state, **kwargs, **_static)
+                else:
+                    out, new_state = self.apply_fn(p, *args, **kwargs, **_static), None
+                return (policy.cast_to_output(out) if _cast else out), new_state
+
+            self._jit_forwards[key] = jax.jit(fwd)
+        out, new_state = self._jit_forwards[key](params, self.extra_state, args, traced_kwargs)
         if new_state is not None and self.training:
             # eval() forwards must be side-effect free: discard state mutations
             # (fp8 amax rolls, batch_stats updates) outside training mode

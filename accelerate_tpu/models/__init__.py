@@ -12,7 +12,14 @@ from .gpt2 import (
     lm_loss_fn_pallas,
     params_from_hf_gpt2,
 )
-from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, llama_sharding_rules, params_from_hf_llama
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_loss_fn,
+    llama_loss_fn_fused,
+    llama_sharding_rules,
+    params_from_hf_llama,
+)
 from .mixtral import (
     MixtralConfig,
     MixtralForCausalLM,

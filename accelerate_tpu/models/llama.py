@@ -208,6 +208,7 @@ class LlamaForCausalLM(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         position_offset: Any = 0,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.config
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
@@ -222,6 +223,11 @@ class LlamaForCausalLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            # fused-CE path: the caller folds the head matmul into the loss
+            # kernel so the [b, s, V] logits never reach HBM (at Llama-3's
+            # 128k vocab that tensor is the training memory wall)
+            return x
         lm_head = self.param("lm_head", nn.initializers.normal(0.02),
                              (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         return jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), lm_head.astype(cfg.dtype),
@@ -296,13 +302,35 @@ def llama_blockwise_state_dict(params: dict) -> dict:
 
 
 def llama_loss_fn(model, batch) -> jax.Array:
-    from .gpt2 import cross_entropy_loss
+    from .gpt2 import _next_token_labels, cross_entropy_loss
 
     logits = model(batch["input_ids"])
-    labels = batch.get("labels")
-    if labels is None:
-        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    return cross_entropy_loss(logits, labels)
+    return cross_entropy_loss(logits, _next_token_labels(batch))
+
+
+def llama_loss_fn_fused(model, batch, block_r: int | None = None,
+                        block_v: int | None = None) -> jax.Array:
+    """Next-token CE with the (untied) LM head folded into the Pallas fused-CE
+    kernel — the [b, s, V] logits tensor never reaches HBM. The memory lever
+    for large-vocab members (Llama-3: V=128k). Same contract as
+    `gpt2.lm_loss_fn_pallas`."""
+    from ..ops.fused_ce import fused_cross_entropy
+    from ..utils.environment import parse_int_from_env
+
+    if block_r is None:
+        block_r = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
+    if block_v is None:
+        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 1024)
+    from .gpt2 import _next_token_labels
+
+    hidden = model(batch["input_ids"], return_hidden=True)
+    labels = _next_token_labels(batch)
+    b, s, e = hidden.shape
+    head = model.params["lm_head"].astype(hidden.dtype)
+    return fused_cross_entropy(
+        hidden.reshape(b * s, e), head, labels.reshape(b * s),
+        block_r=block_r, block_v=block_v,
+    )
 
 
 def params_from_hf_llama(hf_state_dict: dict, config: LlamaConfig) -> dict:

@@ -119,6 +119,30 @@ def test_int8_kv_cache_greedy_generation_tracks_exact():
     assert agreement >= 0.5, f"int8-cache rollout diverged: agreement {agreement}"
 
 
+def test_fused_ce_loss_matches_full_logits():
+    """llama_loss_fn_fused (Pallas head+CE, interpret mode on CPU) must match
+    the dense-logits loss — the Llama-3 128k-vocab memory lever."""
+    from accelerate_tpu.models.llama import llama_loss_fn_fused
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = LlamaForCausalLM(cfg)
+    acc = _fresh()
+    params = module.init_params(jax.random.key(0))
+    model, _ = acc.prepare((module, params), optax.adam(1e-3))
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, cfg.vocab_size, (8, 16)),
+                      dtype=jnp.int32)
+    batch = {"input_ids": ids}
+    dense = float(llama_loss_fn(model, batch))
+    fused = float(llama_loss_fn_fused(model, batch, block_r=64, block_v=64))
+    np.testing.assert_allclose(fused, dense, rtol=2e-4, atol=2e-4)
+
+    # and it trains through the fused step
+    step = acc.make_train_step(
+        lambda m, b: llama_loss_fn_fused(m, b, block_r=64, block_v=64))
+    losses = [float(step(batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+
+
 def test_kv_cache_dtype_rejects_unsupported():
     cfg = LlamaConfig.tiny(dtype=jnp.float32, kv_cache_dtype=jnp.float16)
     module = LlamaForCausalLM(cfg)
