@@ -832,6 +832,14 @@ class Accelerator:
         finally:
             self.gradient_state._set_sync_gradients(prev)
 
+    def trigger_sync_in_backward(self, model: Any = None) -> None:
+        """Make the NEXT backward apply gradients even though the step count
+        says we're mid-accumulation (reference `trigger_sync_in_backward`,
+        `accelerator.py:977`: sets DDP's require_backward_grad_sync after
+        forwards under no_sync). Under SPMD there is no allreduce to re-arm —
+        the equivalent observable effect is forcing the optimizer boundary."""
+        self.gradient_state._set_sync_gradients(True)
+
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables: list, even_batches: bool | None = None):
         """API parity with DDP's Join (reference `accelerator.py:1095-1182`).

@@ -174,6 +174,21 @@ class TestAcceleratorBasics:
         np.testing.assert_array_equal(np.asarray(model.params["a"]), before)
         assert opt.gradients is not None  # zero_grad was a no-op too
 
+    def test_trigger_sync_in_backward_forces_update(self):
+        """Reference `trigger_sync_in_backward` (accelerator.py:977): after
+        forwards that skipped the update, forcing sync makes the NEXT backward
+        apply gradients even mid-accumulation."""
+        acc = _fresh_accelerator(gradient_accumulation_steps=4)
+        batches = make_regression_batches(2, 16)
+        model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+        before = np.asarray(model.params["a"])
+        with acc.accumulate(model):  # step 1 of 4 -> would not sync
+            acc.trigger_sync_in_backward(model)
+            assert acc.sync_gradients
+            acc.backward(regression_loss_fn, {k: jnp.asarray(v) for k, v in batches[0].items()})
+            opt.step()
+        assert not np.array_equal(np.asarray(model.params["a"]), before)
+
     def test_gather_for_metrics_drops_remainder(self):
         acc = _fresh_accelerator()
         gs = GradientState()
