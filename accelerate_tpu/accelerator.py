@@ -335,6 +335,10 @@ class Accelerator:
             parallelism_config=parallelism_config,
             initialization_timeout=init_pg_timeout,
         )
+        if self.deepspeed_plugin is not None:
+            # reference keeps (possibly several, selectable) DS plugins on
+            # AcceleratorState — preserve those accessors
+            self.state.register_deepspeed_plugins(self.deepspeed_plugin)
         self.policy = PrecisionPolicy.from_mode(self.state.mixed_precision)
         if self.policy.requires_loss_scaling:
             self.scaler = DynamicGradScaler(**scaler_config) if scaler_config.pop("enabled", True) else None

@@ -304,6 +304,33 @@ class PartialState:
 
         return wrapper
 
+    def on_local_process(
+        self, function: Callable | None = None, local_process_index: int = 0
+    ) -> Callable:
+        """Run only on the given LOCAL process index (reference `state.py:641`).
+        One JAX process per host means local index 0 is the only inhabitant,
+        so this gates to "every host runs it" vs "no host does"."""
+        if function is None:
+            return functools.partial(
+                self.on_local_process, local_process_index=local_process_index
+            )
+
+        @functools.wraps(function)
+        def wrapper(*args, **kwargs):
+            if self.local_process_index == local_process_index:
+                return function(*args, **kwargs)
+
+        return wrapper
+
+    @property
+    def default_device(self):
+        """The device computation lands on by default (reference
+        `state.py:682` picks MPS/CUDA/...; here it is jax's first device —
+        TPU when attached, else CPU)."""
+        import jax
+
+        return jax.devices()[0]
+
     def print(self, *args, **kwargs) -> None:
         """Print once per job (main host only) — reference `state.py:677`."""
         if self.is_local_main_process:
@@ -361,6 +388,41 @@ class AcceleratorState:
     @property
     def mixed_precision(self) -> str:
         return self.mixed_precision_mode
+
+    # --- DeepSpeed plugin registry (reference `state.py` deepspeed_plugins +
+    # get/select accessors). Plugins here only shape optax/mesh config
+    # (utils/deepspeed.py); the registry preserves the multi-plugin selection
+    # API so reference scripts that switch plugins keep working.
+    @property
+    def deepspeed_plugin(self):
+        """The currently selected DeepSpeed plugin, or None (reference
+        `AcceleratorState.deepspeed_plugin`)."""
+        plugins = self._shared_state.get("deepspeed_plugins") or {}
+        return plugins.get(self._shared_state.get("active_deepspeed_plugin"))
+
+    def register_deepspeed_plugins(self, plugins) -> None:
+        """Accept one plugin or a dict of named plugins; the first becomes
+        active (reference multi-plugin constructor contract)."""
+        if plugins is None:
+            return
+        if not isinstance(plugins, dict):
+            plugins = {"default": plugins}
+        self._shared_state["deepspeed_plugins"] = plugins
+        self._shared_state.setdefault("active_deepspeed_plugin", next(iter(plugins)))
+
+    def get_deepspeed_plugin(self, name: str):
+        """Look up a registered plugin by name (reference `get_deepspeed_plugin`)."""
+        plugins = self._shared_state.get("deepspeed_plugins") or {}
+        if name not in plugins:
+            raise ValueError(
+                f"No DeepSpeed plugin named {name!r}; registered: {sorted(plugins)}"
+            )
+        return plugins[name]
+
+    def select_deepspeed_plugin(self, name: str) -> None:
+        """Make the named plugin active (reference `select_deepspeed_plugin`)."""
+        self.get_deepspeed_plugin(name)  # raises with the registry listed
+        self._shared_state["active_deepspeed_plugin"] = name
 
     # Delegate topology to PartialState
     def __getattr__(self, name: str) -> Any:
@@ -439,6 +501,14 @@ class GradientState:
 
     def _set_sync_gradients(self, sync: bool) -> None:
         self.sync_gradients = sync
+
+    @property
+    def is_xla_gradients_synced(self) -> bool:
+        """Reference `GradientState.is_xla_gradients_synced`: whether the XLA
+        gradient reduction already ran this step. Under SPMD the reduction is
+        part of the compiled step itself, so this is exactly the sync
+        boundary."""
+        return self.sync_gradients
 
     def _add_dataloader(self, dataloader: Any) -> None:
         self.active_dataloader = dataloader

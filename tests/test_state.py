@@ -101,6 +101,44 @@ def test_sagemaker_env_translates_to_jax_contract(monkeypatch):
     assert os.environ["JAX_PROCESS_ID"] == "1"  # sorted order
 
 
+def test_on_local_process_and_default_device():
+    state = PartialState()
+    ran = []
+    state.on_local_process(lambda: ran.append("a"))()
+    state.on_local_process(local_process_index=3)(lambda: ran.append("b"))()
+    assert ran == ["a"]  # single process per host: only local index 0 exists
+    assert state.default_device is not None
+
+
+def test_deepspeed_plugin_registry_and_selection():
+    """Reference multi-plugin accessors: register, get by name, select active."""
+    from accelerate_tpu.state import AcceleratorState
+
+    AcceleratorState._reset_state()
+    st = AcceleratorState()
+    assert st.deepspeed_plugin is None
+    a, b = object(), object()
+    st.register_deepspeed_plugins({"train": a, "eval": b})
+    assert st.deepspeed_plugin is a  # first registered is active
+    assert st.get_deepspeed_plugin("eval") is b
+    st.select_deepspeed_plugin("eval")
+    assert st.deepspeed_plugin is b
+    with pytest.raises(ValueError, match="registered"):
+        st.get_deepspeed_plugin("nope")
+    AcceleratorState._reset_state()
+
+
+def test_gradient_state_xla_sync_alias():
+    from accelerate_tpu.state import GradientState
+
+    GradientState._reset_state()
+    gs = GradientState()
+    assert gs.is_xla_gradients_synced == gs.sync_gradients
+    gs._set_sync_gradients(False)
+    assert gs.is_xla_gradients_synced is False
+    GradientState._reset_state()
+
+
 def test_slurm_step_autodetects_distributed(monkeypatch):
     """Inside a multi-task srun step (reference examples/slurm submit scripts
     role) distributed init must fall through to jax's SLURM cluster detection:
