@@ -19,6 +19,8 @@ from .data_loader import (
     DataLoaderShard,
     IterableDatasetShard,
     SeedableRandomSampler,
+    SkipBatchSampler,
+    get_sampler,
     prepare_data_loader,
     skip_first_batches,
 )

@@ -288,6 +288,21 @@ def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
         accelerator.step = _load_host_state(step_path)["step"]
 
 
+def save_custom_state(obj: Any, path: str | os.PathLike, index: int = 0) -> str:
+    """Persist ONE registered custom object (reference `save_custom_state`,
+    `checkpointing.py:240`): anything exposing ``state_dict()``, written by
+    process 0 as `custom_checkpoint_<index>.pkl`."""
+    target = Path(path) / f"{CUSTOM_STATE_NAME}_{index}.pkl"
+    _save_host_state(target, obj.state_dict())
+    return str(target)
+
+
+def load_custom_state(obj: Any, path: str | os.PathLike, index: int = 0) -> None:
+    """Restore ONE custom object saved by `save_custom_state` (reference
+    `load_custom_state`, `checkpointing.py:252`)."""
+    obj.load_state_dict(_load_host_state(Path(path) / f"{CUSTOM_STATE_NAME}_{index}.pkl"))
+
+
 def save_model_weights(
     state_dict: Any,
     save_directory: str,

@@ -846,6 +846,42 @@ def prepare_data_loader(
     )
 
 
+class SkipBatchSampler:
+    """Wrap any batch sampler, skipping its first ``skip_batches`` batches
+    (reference `SkipBatchSampler`, `data_loader.py:1221`): the sampler-level
+    building block behind `skip_first_batches` for torch loaders whose
+    sampler the caller manages directly."""
+
+    def __init__(self, batch_sampler: Any, skip_batches: int = 0):
+        self.batch_sampler = batch_sampler
+        self.skip_batches = skip_batches
+        # forward the nominal size so BatchSamplerShard keeps exact pad math
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    def __iter__(self):
+        for i, batch in enumerate(self.batch_sampler):
+            if i >= self.skip_batches:
+                yield batch
+
+    @property
+    def total_length(self) -> int:
+        return len(self.batch_sampler)
+
+    def __len__(self) -> int:
+        return max(len(self.batch_sampler) - self.skip_batches, 0)
+
+
+def get_sampler(dataloader: Any):
+    """The index sampler driving a (possibly prepared/wrapped) dataloader
+    (reference `get_sampler`, `data_loader.py:1199`)."""
+    base = getattr(dataloader, "base_loader", dataloader)
+    batch_sampler = getattr(base, "batch_sampler", None)
+    while batch_sampler is not None and hasattr(batch_sampler, "batch_sampler"):
+        batch_sampler = batch_sampler.batch_sampler  # unwrap shard/skip layers
+    return getattr(batch_sampler, "sampler", getattr(base, "sampler", None))
+
+
 def skip_first_batches(dataloader: Any, num_batches: int = 0) -> Any:
     """Resume mid-epoch by skipping the first ``num_batches`` batches
     (reference `data_loader.py:1245-1320`)."""

@@ -362,3 +362,43 @@ class TestTorchInterop:
         out = list(dl)
         assert len(out) == 3
         assert out[0].shape == (8, 1)
+
+
+def test_skip_batch_sampler_and_get_sampler():
+    """SkipBatchSampler skips at the sampler level and forwards the nominal
+    batch_size; get_sampler unwraps shard/skip layers to the index sampler
+    (reference data_loader.py:1199/1221)."""
+    import torch.utils.data as tud
+
+    from accelerate_tpu.data_loader import SkipBatchSampler, get_sampler, prepare_data_loader
+
+    base = tud.BatchSampler(tud.SequentialSampler(range(10)), batch_size=3, drop_last=False)
+    skip = SkipBatchSampler(base, skip_batches=2)
+    assert list(skip) == [[6, 7, 8], [9]]
+    assert len(skip) == 2 and skip.batch_size == 3
+
+    dl = tud.DataLoader(list(range(10)), batch_sampler=skip)
+    assert isinstance(get_sampler(dl), tud.SequentialSampler)
+    prepared = prepare_data_loader(tud.DataLoader(list(range(10)), batch_size=2))
+    assert get_sampler(prepared) is not None
+
+
+def test_save_load_custom_state_roundtrip(tmp_path):
+    from accelerate_tpu.checkpointing import load_custom_state, save_custom_state
+
+    class Thing:
+        def __init__(self):
+            self.v = 1
+
+        def state_dict(self):
+            return {"v": self.v}
+
+        def load_state_dict(self, sd):
+            self.v = sd["v"]
+
+    a = Thing()
+    a.v = 42
+    save_custom_state(a, tmp_path)
+    b = Thing()
+    load_custom_state(b, tmp_path)
+    assert b.v == 42
