@@ -24,6 +24,7 @@ from .mixtral import (
     MixtralConfig,
     MixtralForCausalLM,
     mixtral_loss_fn,
+    mixtral_loss_fn_fused,
     mixtral_sharding_rules,
     params_from_hf_mixtral,
 )

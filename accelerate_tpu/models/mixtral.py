@@ -171,6 +171,7 @@ class MixtralForCausalLM(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         position_offset: Any = 0,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.config
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
@@ -185,6 +186,9 @@ class MixtralForCausalLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, decode, position_offset)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            # fused-CE path (see llama.py): head folds into the loss kernel
+            return x
         lm_head = self.param("lm_head", nn.initializers.normal(0.02),
                              (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         return jnp.einsum("bse,ve->bsv", x.astype(cfg.dtype), lm_head.astype(cfg.dtype),
@@ -254,13 +258,36 @@ def mixtral_blockwise_state_dict(params: dict) -> dict:
 def mixtral_loss_fn(model, batch) -> jax.Array:
     """LM loss + sown router aux losses (must be added inside the grad fn)."""
     from ..ops.moe import collect_aux_losses
-    from .gpt2 import cross_entropy_loss
+    from .gpt2 import _next_token_labels, cross_entropy_loss
 
     logits = model(batch["input_ids"])
-    labels = batch.get("labels")
-    if labels is None:
-        labels = jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    return cross_entropy_loss(logits, labels) + collect_aux_losses(model.extra_state)
+    return cross_entropy_loss(logits, _next_token_labels(batch)) + collect_aux_losses(
+        model.extra_state
+    )
+
+
+def mixtral_loss_fn_fused(model, batch, block_r: int | None = None,
+                          block_v: int | None = None) -> jax.Array:
+    """`mixtral_loss_fn` with the LM head folded into the Pallas fused-CE
+    kernel (no [b, s, V] logits in HBM) + the sown router aux losses."""
+    from ..ops.fused_ce import fused_cross_entropy
+    from ..ops.moe import collect_aux_losses
+    from ..utils.environment import parse_int_from_env
+    from .gpt2 import _next_token_labels
+
+    if block_r is None:
+        block_r = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
+    if block_v is None:
+        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 1024)
+    hidden = model(batch["input_ids"], return_hidden=True)
+    labels = _next_token_labels(batch)
+    b, s, e = hidden.shape
+    head = model.params["lm_head"].astype(hidden.dtype)
+    ce = fused_cross_entropy(
+        hidden.reshape(b * s, e), head, labels.reshape(b * s),
+        block_r=block_r, block_v=block_v,
+    )
+    return ce + collect_aux_losses(model.extra_state)
 
 
 def params_from_hf_mixtral(hf_state_dict: dict, config: MixtralConfig) -> dict:

@@ -121,3 +121,32 @@ def test_mixtral_sliding_window_plumbs_through():
         outs[w] = np.asarray(logits)
     np.testing.assert_allclose(outs[None][:, :4], outs[4][:, :4], atol=1e-5)
     assert np.abs(outs[None][:, 10:] - outs[4][:, 10:]).max() > 1e-4
+
+
+def test_fused_ce_loss_matches_dense_incl_aux():
+    """mixtral_loss_fn_fused == mixtral_loss_fn (CE + router aux) and trains
+    through the fused step."""
+    import optax
+
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.models.mixtral import mixtral_loss_fn_fused
+
+    cfg = MixtralConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32)
+    module = MixtralForCausalLM(cfg)
+    params = module.init_params(jax.random.key(0))
+    acc = _fresh()
+    model, _ = acc.prepare(
+        (module, {"params": params, "intermediates": {}}), optax.adam(1e-3)
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, (8, 16)), dtype=jnp.int32
+    )
+    batch = {"input_ids": ids}
+    dense = float(mixtral_loss_fn(model, batch))
+    fused = float(mixtral_loss_fn_fused(model, batch, block_r=64, block_v=64))
+    np.testing.assert_allclose(fused, dense, rtol=2e-4, atol=2e-4)
+
+    step = acc.make_train_step(
+        lambda m, b: mixtral_loss_fn_fused(m, b, block_r=64, block_v=64))
+    losses = [float(step(batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
