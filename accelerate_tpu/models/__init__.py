@@ -41,6 +41,7 @@ from .t5 import (
     T5ForConditionalGeneration,
     params_from_hf_t5,
     seq2seq_loss_fn,
+    seq2seq_loss_fn_fused,
     shift_tokens_right,
     t5_sharding_rules,
 )

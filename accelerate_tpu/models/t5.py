@@ -228,6 +228,7 @@ class T5ForConditionalGeneration(nn.Module):
         decoder_input_ids: jax.Array,
         attention_mask: jax.Array | None = None,
         decoder_attention_mask: jax.Array | None = None,
+        return_hidden: bool = False,
     ) -> jax.Array:
         cfg = self.config
         shared = self.param("shared_embedding", nn.initializers.normal(1.0),
@@ -244,6 +245,10 @@ class T5ForConditionalGeneration(nn.Module):
         dec_out = T5Stack(cfg, is_decoder=True, name="decoder")(
             dec_x, enc_out=enc_out, self_mask=dec_mask, cross_mask=cross_mask
         )
+        if return_hidden:
+            # fused-CE path: caller folds the head (tied rescale included)
+            # into the loss kernel
+            return dec_out
         dec_out = dec_out.astype(jnp.float32)
         if cfg.tie_word_embeddings:
             # tied head reuses the embedding; logits rescaled per T5
@@ -380,6 +385,42 @@ def seq2seq_loss_fn(model, batch) -> jax.Array:
     logprobs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logprobs, safe[..., None], axis=-1)[..., 0]
     return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def seq2seq_loss_fn_fused(model, batch, block_r: int | None = None,
+                          block_v: int | None = None) -> jax.Array:
+    """`seq2seq_loss_fn` with the head folded into the Pallas fused-CE kernel
+    (no [b, tgt, V] logits in HBM). Tied heads fold the T5 ``d_model**-0.5``
+    logit rescale into the hidden states; untied use the lm_head kernel
+    transposed to [V, e]. Note the head matmul runs in compute dtype inside
+    the kernel (the dense path upcasts to fp32 first) — identical at fp32,
+    within bf16 rounding otherwise."""
+    from ..ops.fused_ce import fused_cross_entropy
+    from ..utils.environment import parse_int_from_env
+
+    if block_r is None:
+        block_r = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_R", 512)
+    if block_v is None:
+        block_v = parse_int_from_env("ACCELERATE_TPU_FUSED_CE_BLOCK_V", 1024)
+    hidden = model(
+        batch["input_ids"],
+        batch["decoder_input_ids"],
+        batch.get("attention_mask"),
+        batch.get("decoder_attention_mask"),
+        return_hidden=True,
+    )
+    b, s, e = hidden.shape
+    cfg_tied = "lm_head" not in model.params
+    if cfg_tied:
+        head = model.params["shared_embedding"].astype(hidden.dtype)
+        hidden = hidden * (e ** -0.5)
+    else:
+        head = model.params["lm_head"]["kernel"].T.astype(hidden.dtype)
+    labels = batch["labels"]
+    return fused_cross_entropy(
+        hidden.reshape(b * s, e), head, labels.reshape(b * s),
+        block_r=block_r, block_v=block_v,
+    )
 
 
 def shift_tokens_right(labels: jax.Array, decoder_start_token_id: int = 0) -> jax.Array:

@@ -117,3 +117,33 @@ def test_training_step_reduces_loss_with_tp_sharding():
     for _ in range(12):
         last = float(step(batch))
     assert last < first * 0.8, (first, last)
+
+
+@pytest.mark.parametrize("tied", [False, True])
+def test_fused_ce_seq2seq_loss_matches_dense(tied):
+    """seq2seq_loss_fn_fused == seq2seq_loss_fn for tied (rescale folded) and
+    untied (transposed kernel) heads, and trains through the fused step."""
+    from accelerate_tpu.models.t5 import seq2seq_loss_fn_fused, shift_tokens_right
+
+    cfg = T5Config.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                        tie_word_embeddings=tied)
+    module = T5ForConditionalGeneration(cfg)
+    params = module.init_params(jax.random.key(0))
+    acc = _fresh()
+    model, _ = acc.prepare((module, params), optax.adam(1e-3))
+    rng = np.random.default_rng(8)
+    labels = rng.integers(0, cfg.vocab_size, (8, 8)).astype(np.int32)
+    labels[:, -2:] = -100  # padded tail
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)), jnp.int32),
+        "decoder_input_ids": jnp.asarray(shift_tokens_right(jnp.asarray(labels))),
+        "labels": jnp.asarray(labels),
+    }
+    dense = float(seq2seq_loss_fn(model, batch))
+    fused = float(seq2seq_loss_fn_fused(model, batch, block_r=64, block_v=64))
+    np.testing.assert_allclose(fused, dense, rtol=2e-4, atol=2e-4)
+
+    step = acc.make_train_step(
+        lambda m, b: seq2seq_loss_fn_fused(m, b, block_r=64, block_v=64))
+    losses = [float(step(batch)) for _ in range(4)]
+    assert losses[-1] < losses[0]
