@@ -377,7 +377,10 @@ def test_notebook_launcher_closure_multiprocess(tmp_path):
         notebook_launcher(train, num_processes=2, use_port="0")
     """
     res = _run_notebook_sim(textwrap.dedent(body), tmp_path)
-    assert res.returncode == 0, res.stderr[-2000:]
+    # on failure surface the WORKER's traceback (printed before the parent's
+    # RuntimeError), not just the tail — the tail alone made a rare
+    # under-load failure undiagnosable
+    assert res.returncode == 0, f"stderr:\n{res.stderr[-8000:]}"
     assert proof.read_text() == "ok"
 
 
