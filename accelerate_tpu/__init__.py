@@ -34,6 +34,14 @@ from .parallel.pipeline import pipeline_apply, stack_stage_params
 from .parallel.ring_attention import ring_attention, ring_attention_sharded
 from .parallel.sharding import ShardingRules, infer_param_shardings
 from .scheduler import AcceleratedScheduler, OptaxSchedule
+from .serving import (
+    FIFOScheduler,
+    Request,
+    RequestOutput,
+    SamplingParams,
+    ServingEngine,
+    ServingMetrics,
+)
 from .state import AcceleratorState, DistributedType, GradientState, PartialState
 from .utils.operations import (
     broadcast,

@@ -13,6 +13,11 @@ leading ``stage`` dim and the GPipe schedule runs as one SPMD program
 trunk and run replicated on every device, which also realizes the reference's
 "broadcast the last stage's output to all ranks" step for free: every device
 finishes with the full logits.
+
+Serving: for request-level (rather than batch-level) inference, the
+continuous-batching engine lives in `serving/` — `ServingEngine` (re-exported
+here) multiplexes independent requests through one jitted decode step over a
+fixed pool of KV-cache slots. See `docs/serving.md`.
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .big_modeling import BlockwiseModel
 from .parallel.pipeline import pipeline_apply
+from .serving import ServingEngine  # noqa: F401  (re-export: serving entry point)
 from .state import PartialState
 
 

@@ -52,6 +52,11 @@ class GPT2Config:
     scan_layers: bool = False
     attention_impl: str = "auto"  # 'xla' | 'flash' | 'auto'
     kv_cache_dtype: Any = None  # None | jnp.int8 (see models/kv_cache.py)
+    # per-slot [b]-vector cache write index instead of one scalar shared by the
+    # batch: every row decodes at its own position (the serving engine's
+    # continuous-batching slot pool — serving/engine.py). position_offset may
+    # then be a [b] vector too.
+    kv_cache_per_slot: bool = False
     # fp8 projections (reference TE convert_model role): a DelayedScalingRecipe
     # switches every block Dense to ops/fp8.Fp8Dense (delayed-scaling fp8
     # matmuls; scaling state rides the mutable fp8_meta collection)
@@ -108,13 +113,21 @@ class SelfAttention(nn.Module):
 
             max_len = cfg.n_positions
             k_all, v_all, idx, is_init = decode_cache_update(
-                self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype
+                self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype,
+                per_slot=cfg.kv_cache_per_slot,
             )
             if is_init:
-                # query i (global pos idx+i) may attend cache slots <= idx+i
-                q_pos = idx + jnp.arange(s)[:, None]
-                kv_pos = jnp.arange(max_len)[None, :]
-                mask = kv_pos <= q_pos  # [s, max_len]
+                if cfg.kv_cache_per_slot:
+                    # idx is [b]: row i's query j (global pos idx[i]+j) may
+                    # attend its own cache slots <= idx[i]+j
+                    q_pos = idx[:, None, None] + jnp.arange(s)[None, :, None]
+                    kv_pos = jnp.arange(max_len)[None, None, :]
+                    mask = (kv_pos <= q_pos)[:, None]  # [b, 1, s, max_len]
+                else:
+                    # query i (global pos idx+i) may attend cache slots <= idx+i
+                    q_pos = idx + jnp.arange(s)[:, None]
+                    kv_pos = jnp.arange(max_len)[None, :]
+                    mask = kv_pos <= q_pos  # [s, max_len]
                 out = attention(q, k_all, v_all, causal=False, mask=mask, implementation="xla")
             else:
                 out = attention(q, k_all, v_all, causal=True, implementation="xla")
@@ -184,8 +197,16 @@ class GPT2LMHead(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), cfg.param_dtype
         )
-        positions = position_offset + jnp.arange(s)
-        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[positions][None]
+        positions = jnp.asarray(position_offset)
+        if positions.ndim == 0:
+            positions = positions + jnp.arange(s)  # [s], shared by the batch
+            pos_emb = wpe.astype(cfg.dtype)[positions][None]
+        else:
+            # [b]-vector offsets: every row sits at its own sequence position
+            # (per-slot decode, serving/engine.py)
+            positions = positions[:, None] + jnp.arange(s)  # [b, s]
+            pos_emb = wpe.astype(cfg.dtype)[positions]
+        x = wte.astype(cfg.dtype)[input_ids] + pos_emb
 
         block = Block
         if cfg.remat:

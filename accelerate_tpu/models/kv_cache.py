@@ -1,9 +1,14 @@
 """Decode-time KV cache: the flax decode idiom (fixed-length buffers, running
 write index) shared by every autoregressive model in the zoo, with optional
-int8 blockwise storage (one fp32 absmax scale per (batch, position, kv-head) —
-halves cache HBM, the decode-attention bandwidth term; the dequantize fuses
-into the attention matmuls). Beyond the reference: its bnb integration
-quantizes weights only.
+int8 blockwise storage (one fp32 absmax scale per (batch, position, kv-head)).
+
+The int8 saving is storage/capacity: the cache occupies half the HBM, so
+longer contexts (or more serving slots) fit per chip. It is a *bandwidth* win
+only when XLA fuses the int8->fp32 convert into the attention matmuls — the
+update below dequantizes the full ``[b, max_len, kv_heads, head_dim]`` buffer
+every decode step, so an unfused backend materializes a compute-dtype copy and
+pays the full-precision bandwidth term anyway. Beyond the reference: its bnb
+integration quantizes weights only.
 """
 
 from __future__ import annotations
@@ -21,6 +26,7 @@ def decode_cache_update(
     v: jax.Array,
     max_len: int,
     kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized
+    per_slot: bool = False,  # [b]-vector write index (continuous batching)
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Create/update the module's decode cache and return
     ``(k_all, v_all, write_index, is_init)``.
@@ -29,6 +35,13 @@ def decode_cache_update(
     dtype (dequantized when stored int8) — on the first (shape-init) trace they
     are just ``k``/``v`` and ``is_init`` is False. ``write_index`` is the cache
     position the new entries were written at.
+
+    ``per_slot=True`` replaces the scalar write index shared by the whole batch
+    with a ``[b]`` vector: row ``i`` writes its new entries at its own
+    ``cache_index[i]`` (the serving engine's slot pool, where every slot sits at
+    a different position in an independent sequence — `serving/engine.py`).
+    ``write_index`` is then the ``[b]`` vector and row starts clamp into range
+    exactly like ``dynamic_update_slice``.
     """
     if kv_cache_dtype is not None and np.dtype(kv_cache_dtype) != np.dtype("int8"):
         # fail fast with the cause named — an arbitrary dtype would surface as
@@ -49,7 +62,10 @@ def decode_cache_update(
                                (b, max_len, kv_heads), jnp.float32)
         v_scale = mod.variable("cache", "value_scale", jnp.zeros,
                                (b, max_len, kv_heads), jnp.float32)
-    cache_idx = mod.variable("cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+    cache_idx = mod.variable(
+        "cache", "cache_index",
+        lambda: jnp.zeros((b,) if per_slot else (), jnp.int32),
+    )
 
     if not is_init:
         return k, v, cache_idx.value, False
@@ -65,7 +81,25 @@ def decode_cache_update(
         return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
     idx = cache_idx.value
-    if quant:
+    if per_slot:
+        # row-wise scatter: each batch row writes at its own index (vmapped
+        # dynamic_update_slice keeps the update static-shape and fully jittable)
+        row4 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0, 0)))
+        row3 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0)))
+        if quant:
+            kq, ks = _q(k)
+            vq, vs = _q(v)
+            cached_k.value = row4(cached_k.value, kq, idx)
+            cached_v.value = row4(cached_v.value, vq, idx)
+            k_scale.value = row3(k_scale.value, ks, idx)
+            v_scale.value = row3(v_scale.value, vs, idx)
+            k_all = _dq(cached_k.value, k_scale.value, k.dtype)
+            v_all = _dq(cached_v.value, v_scale.value, v.dtype)
+        else:
+            cached_k.value = row4(cached_k.value, k, idx)
+            cached_v.value = row4(cached_v.value, v, idx)
+            k_all, v_all = cached_k.value, cached_v.value
+    elif quant:
         kq, ks = _q(k)
         vq, vs = _q(v)
         cached_k.value = jax.lax.dynamic_update_slice(cached_k.value, kq, (0, idx, 0, 0))

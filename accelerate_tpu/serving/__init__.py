@@ -1,0 +1,37 @@
+"""Continuous-batching serving (`docs/serving.md`).
+
+`ServingEngine` keeps one jitted, static-shape decode step hot and multiplexes
+independent requests through a fixed pool of KV-cache slots: slot-level
+admission, per-request sampling params, FIFO queue with backpressure, and
+counters/histograms exported through the `tracking.py` tracker interface.
+"""
+
+from .engine import ServingEngine
+from .metrics import Counter, Histogram, ServingMetrics
+from .request import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    REJECT_PROMPT_TOO_LONG,
+    REJECT_QUEUE_FULL,
+    Request,
+    RequestOutput,
+    SamplingParams,
+    SubmitResult,
+)
+from .scheduler import FIFOScheduler
+
+__all__ = [
+    "ServingEngine",
+    "ServingMetrics",
+    "Counter",
+    "Histogram",
+    "FIFOScheduler",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "SubmitResult",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "REJECT_QUEUE_FULL",
+    "REJECT_PROMPT_TOO_LONG",
+]

@@ -1,0 +1,386 @@
+"""Continuous-batching serving engine: one hot decode step, many requests.
+
+`models/generation.generate` runs a batch in lockstep — equal-length prompts,
+every row decodes until the slowest finishes, nobody joins mid-flight. This
+engine multiplexes independent requests through ONE jitted, static-shape
+decode step instead (the serving half of the ROADMAP north star):
+
+  - a pre-allocated per-slot KV cache pool, ``[max_concurrency, n_positions,
+    ...]`` fixed buffers in the `models/kv_cache.py` layout with the per-slot
+    ``[b]`` write-index variant (int8 storage supported via the model config's
+    ``kv_cache_dtype``);
+  - admission prefills one request at a bucketed prompt length into a fresh
+    single-slot cache and scatters it into the pool at the free slot — one
+    compile per bucket, never per prompt length — and samples the first token
+    in the same jitted call (TTFT = queue wait + one prefill);
+  - ``step()`` decodes ALL slots in one jitted call with donated cache
+    buffers; per-slot positions, sampling params, and rng keys ride as
+    ``[max_concurrency]`` data arrays, so requests joining or retiring never
+    retrace;
+  - a slot is recycled the moment its request hits EOS, its token budget, or
+    the context limit; the FIFO scheduler backfills it on the next step.
+
+Static-shape invariant (the whole point): the decode step's shapes depend only
+on ``(max_concurrency, n_positions, model config)`` and admission's only on
+the prompt bucket. Everything request-specific is data, not shape.
+
+Sampling parity: the per-slot sampler value-matches `generation._sample` and
+the per-slot rng chain matches `generate`'s split sequence for a batch-1 call,
+so a request served here emits the SAME tokens as a solo ``generate`` with
+``rng=jax.random.key(seed)`` (tests/test_serving.py proves it token-level).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .metrics import ServingMetrics
+from .request import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    REJECT_QUEUE_FULL,
+    Request,
+    RequestOutput,
+    SamplingParams,
+    SubmitResult,
+)
+from .scheduler import FIFOScheduler
+
+
+def _sample_slot(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+                 top_k: jax.Array) -> jax.Array:
+    """Sample one slot's next token from ``[vocab]`` logits.
+
+    Value-matches `models/generation._sample` on a single row with the same
+    key (the parity contract), but temperature/top_k are DATA here — the
+    static python branches become jnp.where so every slot can carry its own
+    settings inside one compiled step. top_k == 0 disables the top-k mask.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    vocab = logits.shape[-1]
+    safe_t = jnp.where(temperature > 0, temperature, jnp.ones_like(temperature))
+    scaled = logits / safe_t
+    ordered = jnp.sort(scaled, axis=-1)  # ascending, like _sample's kth lookup
+    kth = jnp.take(ordered, vocab - jnp.clip(top_k, 1, vocab))
+    masked = jnp.where((top_k > 0) & (scaled < kth), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+class ServingEngine:
+    """Request-level continuous batching over a fixed pool of decode slots.
+
+    ``module`` is any causal LM whose config supports ``kv_cache_per_slot``
+    (GPT-2 today); the engine re-instantiates it with the flag on, so callers
+    pass the same module they would hand to ``generate``. ``params`` is the
+    matching param tree. The context length is the config's ``n_positions``.
+
+    Typical loop::
+
+        engine = ServingEngine(module, params, max_concurrency=8)
+        engine.submit(prompt_ids, SamplingParams(max_new_tokens=64))
+        while engine.has_work:
+            for out in engine.step():
+                ...  # out.tokens, out.finish_reason
+
+    or just ``outputs = engine.run(requests)``.
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        params: Any,
+        *,
+        max_concurrency: int = 8,
+        prompt_buckets: tuple[int, ...] = (32, 128, 512),
+        max_queue: int = 128,
+        eos_token_id: int | None = None,
+        tracker: Any = None,
+        metrics_log_every: int = 0,
+        metrics: ServingMetrics | None = None,
+    ):
+        cfg = getattr(module, "config", None)
+        if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
+            raise TypeError(
+                f"{type(module).__name__} has no kv_cache_per_slot config flag; "
+                "the serving engine needs the per-slot cache variant "
+                "(models/kv_cache.py) — GPT2LMHead supports it."
+            )
+        if not cfg.kv_cache_per_slot:
+            module = type(module)(dataclasses.replace(cfg, kv_cache_per_slot=True))
+        self.module = module
+        self.params = params
+        self.max_len = int(module.config.n_positions)
+        self.max_concurrency = int(max_concurrency)
+        if self.max_concurrency < 1:
+            raise ValueError(f"max_concurrency must be >= 1, got {max_concurrency}")
+        buckets = tuple(sorted({int(b) for b in prompt_buckets if int(b) <= self.max_len}))
+        if not buckets:
+            raise ValueError(
+                f"no prompt bucket fits n_positions={self.max_len}: {prompt_buckets}"
+            )
+        # cap admitted prompts one short of the context so every request can
+        # emit at least one token
+        self.scheduler = FIFOScheduler(
+            prompt_buckets=buckets, max_queue=max_queue,
+            max_prompt_len=min(buckets[-1], self.max_len - 1),
+        )
+        self.eos_token_id = eos_token_id
+        self.metrics = metrics or ServingMetrics()
+        self.tracker = tracker
+        self.metrics_log_every = int(metrics_log_every)
+
+        b = self.max_concurrency
+        # device state: the slot-pool cache (donated through every step) and
+        # the per-slot rng chain, kept as raw key data so slot updates are
+        # plain .at[].set ops
+        self._cache = self.module.init(
+            jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True
+        )["cache"]
+        kd = jax.random.key_data(jax.random.key(0))
+        self._rng_data = jnp.zeros((b,) + kd.shape, kd.dtype)
+        self._fresh_shapes = jax.eval_shape(
+            lambda: self.module.init(
+                jax.random.key(0), jnp.zeros((1, 1), jnp.int32), decode=True
+            )["cache"]
+        )
+        # host-side slot state, passed into the step as [b] data arrays
+        self._tokens = np.zeros(b, np.int32)
+        self._pos = np.zeros(b, np.int32)
+        self._temps = np.zeros(b, np.float32)
+        self._topks = np.zeros(b, np.int32)
+        self._active = np.zeros(b, bool)
+        self._budget = np.zeros(b, np.int64)
+        self._slot_req: list[Request | None] = [None] * b
+        self._slot_out: list[RequestOutput | None] = [None] * b
+        self._slot_last_token_t = [0.0] * b
+        self._free: deque[int] = deque(range(b))
+        self._next_id = 0
+        self._step_count = 0
+        self._step_fn = self._build_step_fn()
+        self._admit_fn = self._build_admit_fn()
+
+    # ------------------------------------------------------------- jitted fns
+    def _build_step_fn(self):
+        module = self.module
+
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data):
+            logits, mutated = module.apply(
+                {"params": params, "cache": cache}, tokens[:, None], decode=True,
+                position_offset=pos, mutable=["cache"],
+            )
+            rngs = jax.random.wrap_key_data(rng_data)
+            split = jax.vmap(jax.random.split)(rngs)  # [b, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            nxt = jax.vmap(_sample_slot)(logits[:, -1], keys, temps, top_ks)
+            return mutated["cache"], nxt, jax.random.key_data(new_rngs)
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _build_admit_fn(self):
+        module, fresh_shapes = self.module, self._fresh_shapes
+
+        def admit_fn(pool_cache, params, prompt_row, slot, prompt_len, temp, top_k, rng):
+            # prefill the whole (right-padded) bucket into a fresh single-slot
+            # cache; the causal mask keeps pad positions from reaching the last
+            # real token's logits, and the write index reset below keeps decode
+            # from ever attending the stale pad entries
+            fresh = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), fresh_shapes)
+            logits, mutated = module.apply(
+                {"params": params, "cache": fresh}, prompt_row[None, :], decode=True,
+                position_offset=0, mutable=["cache"],
+            )
+            last = jax.lax.dynamic_slice(
+                logits[0], (prompt_len - 1, 0), (1, logits.shape[-1])
+            )[0]
+            rng, key = jax.random.split(rng)
+            token = _sample_slot(last, key, temp, top_k)
+
+            def insert(path, pool_leaf, new_leaf):
+                if getattr(path[-1], "key", None) == "cache_index":
+                    # the prefill wrote the full bucket; the slot's true length
+                    # is the unpadded prompt — decode resumes (and overwrites
+                    # the pad entries) from there
+                    new_leaf = jnp.full_like(new_leaf, prompt_len)
+                start = (slot,) + (0,) * (pool_leaf.ndim - 1)
+                return jax.lax.dynamic_update_slice(
+                    pool_leaf, new_leaf.astype(pool_leaf.dtype), start
+                )
+
+            new_pool = jax.tree_util.tree_map_with_path(
+                insert, pool_cache, mutated["cache"]
+            )
+            return new_pool, token, jax.random.key_data(rng)
+
+        return jax.jit(admit_fn, donate_argnums=(0,))
+
+    # --------------------------------------------------------------- requests
+    def submit(self, request: Request | Iterable[int],
+               params: SamplingParams | None = None) -> SubmitResult:
+        """Queue a request (a `Request` or a bare token-id sequence).
+
+        Never blocks: a full queue or oversized prompt returns a rejection
+        with a reason code instead (backpressure — shed or retry upstream).
+        """
+        if not isinstance(request, Request):
+            request = Request(prompt=list(request), params=params or SamplingParams())
+        request.request_id = self._next_id
+        self._next_id += 1
+        if request.arrival_time is None:
+            request.arrival_time = time.perf_counter()
+        self.metrics.mark_start()
+        result = self.scheduler.submit(request)
+        if result.accepted:
+            self.metrics.requests_submitted.inc()
+        else:
+            self.metrics.requests_rejected.inc()
+        return result
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active.any()) or self.scheduler.queue_depth > 0
+
+    @property
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    # ------------------------------------------------------------ engine loop
+    def step(self) -> list[RequestOutput]:
+        """Admit into free slots, decode one token for every active slot, and
+        return the requests that finished during this step."""
+        finished: list[RequestOutput] = []
+        self._admit_pending(finished)
+        n_active = self.active_slots
+        self.metrics.observe_step(n_active, self.max_concurrency,
+                                  self.scheduler.queue_depth)
+        self._step_count += 1
+        if n_active:
+            cache, nxt, rng_data = self._step_fn(
+                self._cache, self.params, jnp.asarray(self._tokens),
+                jnp.asarray(self._pos), jnp.asarray(self._temps),
+                jnp.asarray(self._topks), self._rng_data,
+            )
+            self._cache, self._rng_data = cache, rng_data
+            tokens = np.asarray(jax.device_get(nxt))
+            now = time.perf_counter()
+            for slot in np.flatnonzero(self._active):
+                slot = int(slot)
+                self._emit_token(slot, int(tokens[slot]), now, finished)
+        if (self.tracker is not None and self.metrics_log_every
+                and self._step_count % self.metrics_log_every == 0):
+            self.metrics.log_to(self.tracker, step=self._step_count)
+        return finished
+
+    def run(self, requests: Iterable[Request], max_steps: int | None = None
+            ) -> list[RequestOutput]:
+        """Serve a batch of requests to completion, respecting backpressure
+        (a queue-full rejection just defers the submit until slots drain).
+        Returns outputs in submission order; structurally rejected requests
+        (e.g. oversized prompts) come back with ``finish_reason='rejected:…'``.
+        """
+        pending = deque(requests)
+        outputs: dict[int, RequestOutput] = {}
+        steps = 0
+        while pending or self.has_work:
+            while pending:
+                result = self.submit(pending[0])
+                if result.accepted:
+                    pending.popleft()
+                elif result.reason == REJECT_QUEUE_FULL:
+                    break  # drain a step, then retry
+                else:
+                    req = pending.popleft()
+                    outputs[result.request_id] = RequestOutput(
+                        request_id=result.request_id, prompt_len=len(req.prompt),
+                        tokens=[], finish_reason=f"rejected:{result.reason}",
+                        arrival_time=req.arrival_time,
+                    )
+            for out in self.step():
+                outputs[out.request_id] = out
+            steps += 1
+            if max_steps is not None and steps > max_steps:
+                raise RuntimeError(f"run() exceeded {max_steps} steps with work left")
+        return [outputs[k] for k in sorted(outputs)]
+
+    # -------------------------------------------------------------- internals
+    def _admit_pending(self, finished: list[RequestOutput]) -> None:
+        while self._free:
+            request = self.scheduler.next_ready()
+            if request is None:
+                return
+            slot = self._free.popleft()
+            prompt_len = len(request.prompt)
+            bucket = self.scheduler.bucket_for(prompt_len)
+            padded = np.zeros(bucket, np.int32)
+            padded[:prompt_len] = request.prompt
+            sp = request.params
+            cache, token, rng_data = self._admit_fn(
+                self._cache, self.params, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(prompt_len),
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k or 0),
+                jax.random.key(sp.seed),
+            )
+            self._cache = cache
+            self._rng_data = self._rng_data.at[slot].set(rng_data)
+            first = int(jax.device_get(token))
+            now = time.perf_counter()
+            out = RequestOutput(
+                request_id=request.request_id, prompt_len=prompt_len, tokens=[],
+                finish_reason="", arrival_time=request.arrival_time,
+                first_token_time=now,
+            )
+            self._slot_req[slot] = request
+            self._slot_out[slot] = out
+            self._tokens[slot] = first
+            self._pos[slot] = prompt_len
+            self._temps[slot] = sp.temperature
+            self._topks[slot] = sp.top_k or 0
+            # the context is fixed-size: cap generation so cache writes stay
+            # inside [0, n_positions)
+            self._budget[slot] = min(int(sp.max_new_tokens), self.max_len - prompt_len)
+            self._active[slot] = True
+            self.metrics.prefill_tokens.inc(prompt_len)
+            if request.arrival_time is not None:
+                self.metrics.ttft_s.observe(max(0.0, now - request.arrival_time))
+            self._emit_token(slot, first, now, finished, from_admit=True)
+
+    def _emit_token(self, slot: int, token: int, now: float,
+                    finished: list[RequestOutput], from_admit: bool = False) -> None:
+        out = self._slot_out[slot]
+        out.tokens.append(token)
+        self.metrics.tokens_generated.inc()
+        if not from_admit:
+            self._pos[slot] += 1
+            self._tokens[slot] = token
+            self.metrics.inter_token_s.observe(now - self._slot_last_token_t[slot])
+        self._slot_last_token_t[slot] = now
+        if self.eos_token_id is not None and token == self.eos_token_id:
+            self._retire(slot, FINISH_EOS, now, finished)
+        elif len(out.tokens) >= self._budget[slot]:
+            self._retire(slot, FINISH_LENGTH, now, finished)
+
+    def _retire(self, slot: int, reason: str, now: float,
+                finished: list[RequestOutput]) -> None:
+        out = self._slot_out[slot]
+        out.finish_reason = reason
+        out.finish_time = now
+        if out.arrival_time is not None:
+            self.metrics.request_latency_s.observe(max(0.0, now - out.arrival_time))
+        self.metrics.requests_finished.inc()
+        self._slot_req[slot] = None
+        self._slot_out[slot] = None
+        self._active[slot] = False
+        self._pos[slot] = 0
+        self._tokens[slot] = 0
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._budget[slot] = 0
+        self._free.append(slot)
+        finished.append(out)

@@ -1,0 +1,144 @@
+"""Serving observability: counters and histograms, exported through the
+`tracking.py` tracker interface (`ServingMetrics.log_to(tracker)` emits one
+flat scalar dict per call, so any `GeneralTracker` — JSONL, TensorBoard,
+WandB... — records the serving telemetry without serving-specific hooks).
+
+Everything here is host-side bookkeeping; nothing touches the device path.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Histogram:
+    """Streaming histogram: exact count/sum/min/max plus a bounded,
+    deterministically-strided sample reservoir for quantiles (no RNG — a
+    metrics read must never perturb per-request seeding)."""
+
+    def __init__(self, max_samples: int = 4096):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._max_samples = int(max_samples)
+        self._stride = 1
+        self._samples: list[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        if self.count % self._stride == 0:
+            self._samples.append(value)
+            if len(self._samples) > self._max_samples:
+                # decimate and double the stride: memory stays bounded while
+                # the reservoir keeps spanning the whole stream
+                self._samples = self._samples[::2]
+                self._stride *= 2
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        ordered = sorted(self._samples)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+    def summary(self) -> dict[str, float]:
+        if not self.count:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class ServingMetrics:
+    """The engine's counters and histograms in one bag.
+
+    Latency histograms are in seconds: ``ttft_s`` (submit -> first token),
+    ``inter_token_s`` (gap between consecutive tokens of one request),
+    ``request_latency_s`` (submit -> finish). ``queue_depth`` and
+    ``slot_occupancy`` are sampled once per engine step.
+    """
+
+    def __init__(self):
+        self.requests_submitted = Counter()
+        self.requests_rejected = Counter()
+        self.requests_finished = Counter()
+        self.tokens_generated = Counter()
+        self.prefill_tokens = Counter()
+        self.steps = Counter()
+        self.ttft_s = Histogram()
+        self.inter_token_s = Histogram()
+        self.request_latency_s = Histogram()
+        self.queue_depth = Histogram()
+        self.slot_occupancy = Histogram()
+        self._start: float | None = None
+
+    def mark_start(self) -> None:
+        """First-event clock for the aggregate tokens/sec rate."""
+        if self._start is None:
+            self._start = time.perf_counter()
+
+    def observe_step(self, active: int, capacity: int, queue_depth: int) -> None:
+        self.steps.inc()
+        self.slot_occupancy.observe(active / capacity if capacity else 0.0)
+        self.queue_depth.observe(queue_depth)
+
+    def tokens_per_sec(self) -> float:
+        if self._start is None:
+            return 0.0
+        dt = time.perf_counter() - self._start
+        return self.tokens_generated.value / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict[str, Any]:
+        """Flat scalar dict — the shape every tracker's ``log`` accepts."""
+        out: dict[str, Any] = {
+            "serving/requests_submitted": self.requests_submitted.value,
+            "serving/requests_rejected": self.requests_rejected.value,
+            "serving/requests_finished": self.requests_finished.value,
+            "serving/tokens_generated": self.tokens_generated.value,
+            "serving/prefill_tokens": self.prefill_tokens.value,
+            "serving/steps": self.steps.value,
+            "serving/tokens_per_sec": self.tokens_per_sec(),
+        }
+        for name, hist in (
+            ("ttft_s", self.ttft_s),
+            ("inter_token_s", self.inter_token_s),
+            ("request_latency_s", self.request_latency_s),
+            ("queue_depth", self.queue_depth),
+            ("slot_occupancy", self.slot_occupancy),
+        ):
+            for stat, value in hist.summary().items():
+                out[f"serving/{name}/{stat}"] = value
+        return out
+
+    def log_to(self, tracker: Any, step: int | None = None) -> None:
+        """Emit the snapshot through a `tracking.GeneralTracker`."""
+        tracker.log(self.snapshot(), step=step)

@@ -1,0 +1,71 @@
+"""Request/response surface of the serving engine.
+
+Per-request sampling params (temperature, top_k, seed) are applied *per slot*
+inside the shared jitted decode step — they ride as ``[max_concurrency]``
+arrays, so two requests with different settings share one compiled program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# finish reasons
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+
+# rejection reason codes (SubmitResult.reason); human detail rides separately
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+REJECT_EMPTY_PROMPT = "empty_prompt"
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode settings (the `models/generation.generate` knobs plus
+    a seed: temperature=0 is greedy, otherwise categorical with optional top-k;
+    the seed makes a sampled request reproducible across runs and engines)."""
+
+    temperature: float = 0.0
+    top_k: int | None = None
+    seed: int = 0
+    max_new_tokens: int = 32
+
+
+@dataclass
+class Request:
+    """One generation request: a token-id prompt plus its sampling params.
+
+    ``request_id``/``arrival_time`` are stamped by `ServingEngine.submit`;
+    supply ``arrival_time`` explicitly to replay a recorded trace.
+    """
+
+    prompt: list[int]
+    params: SamplingParams = field(default_factory=SamplingParams)
+    request_id: int | None = None
+    arrival_time: float | None = None
+
+
+@dataclass
+class RequestOutput:
+    """Tokens generated for one request, with host-clock latency marks
+    (`metrics.ServingMetrics` aggregates these into TTFT / inter-token
+    histograms)."""
+
+    request_id: int
+    prompt_len: int
+    tokens: list[int]
+    finish_reason: str
+    arrival_time: float | None = None
+    first_token_time: float | None = None
+    finish_time: float | None = None
+
+
+@dataclass(frozen=True)
+class SubmitResult:
+    """Admission verdict: accepted into the queue, or rejected with a reason
+    code (backpressure — the caller decides whether to retry or shed load)."""
+
+    accepted: bool
+    request_id: int | None = None
+    reason: str | None = None
+    detail: str | None = None

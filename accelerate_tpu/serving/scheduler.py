@@ -1,0 +1,76 @@
+"""FIFO admission queue with prompt-length bucketing and bounded backpressure.
+
+Bucketing keeps prefill static-shape: a prompt is right-padded to the smallest
+configured bucket that holds it, so admission compiles once per bucket, never
+per prompt length. The queue is bounded; a full queue rejects with a reason
+instead of growing without limit (the engine's only unbounded resource would
+otherwise be host memory).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from .request import (
+    REJECT_EMPTY_PROMPT,
+    REJECT_PROMPT_TOO_LONG,
+    REJECT_QUEUE_FULL,
+    Request,
+    SubmitResult,
+)
+
+
+class FIFOScheduler:
+    """Admission control for the serving engine: validate, enqueue in arrival
+    order, hand requests to free slots, and push back when full."""
+
+    def __init__(
+        self,
+        prompt_buckets: tuple[int, ...] = (32, 128, 512),
+        max_queue: int = 128,
+        max_prompt_len: int | None = None,
+    ):
+        self.buckets = tuple(sorted({int(b) for b in prompt_buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"prompt_buckets must be positive ints, got {prompt_buckets}")
+        self.max_queue = int(max_queue)
+        # the engine caps this at n_positions - 1 so every admitted request has
+        # room for at least one generated token
+        self.max_prompt_len = int(max_prompt_len or self.buckets[-1])
+        self._queue: deque[Request] = deque()
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest bucket holding ``prompt_len`` (the prefill pad target)."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket {self.buckets[-1]}"
+        )
+
+    def submit(self, request: Request) -> SubmitResult:
+        """Enqueue or reject-with-reason (never blocks, never raises on load)."""
+        n = len(request.prompt)
+        if n == 0:
+            return SubmitResult(False, request.request_id, REJECT_EMPTY_PROMPT,
+                                "prompt has no tokens")
+        if n > self.max_prompt_len or n > self.buckets[-1]:
+            return SubmitResult(
+                False, request.request_id, REJECT_PROMPT_TOO_LONG,
+                f"prompt length {n} > max {min(self.max_prompt_len, self.buckets[-1])}",
+            )
+        if len(self._queue) >= self.max_queue:
+            return SubmitResult(
+                False, request.request_id, REJECT_QUEUE_FULL,
+                f"{len(self._queue)} requests already queued",
+            )
+        self._queue.append(request)
+        return SubmitResult(True, request.request_id)
+
+    def next_ready(self) -> Request | None:
+        """Pop the oldest queued request (FIFO), or None when idle."""
+        return self._queue.popleft() if self._queue else None
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)

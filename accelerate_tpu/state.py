@@ -111,12 +111,30 @@ def _maybe_init_distributed(initialization_timeout: int | None = None) -> None:
                     jax.distributed.initialize(**extra)
                 except (RuntimeError, ValueError) as e:
                     # the user explicitly ran a multi-task srun step; falling
-                    # back to N duplicate single-process runs is NOT benign
-                    logger.warning(
-                        "multi-task SLURM step detected but "
-                        "jax.distributed.initialize failed (%s); each task now "
-                        "runs as an independent single-process world", e,
-                    )
+                    # back to N duplicate single-process worlds is NOT benign —
+                    # every task would claim main-process and write the same
+                    # checkpoint/output paths. Refuse unless explicitly opted
+                    # out (the opt-out keeps salvage-a-broken-cluster debugging
+                    # possible).
+                    from .utils.environment import parse_flag_from_env
+
+                    if parse_flag_from_env("ACCELERATE_TPU_ALLOW_SLURM_FALLBACK"):
+                        logger.warning(
+                            "multi-task SLURM step detected but "
+                            "jax.distributed.initialize failed (%s); "
+                            "ACCELERATE_TPU_ALLOW_SLURM_FALLBACK=1 set — each "
+                            "task now runs as an independent single-process "
+                            "world", e,
+                        )
+                    else:
+                        raise RuntimeError(
+                            "multi-task SLURM step detected (SLURM_STEP_NUM_TASKS"
+                            " > 1) but jax.distributed.initialize failed; "
+                            "continuing would run N independent duplicate "
+                            "single-process jobs that overwrite each other's "
+                            "outputs. Set ACCELERATE_TPU_ALLOW_SLURM_FALLBACK=1 "
+                            "to allow the single-process fallback anyway."
+                        ) from e
         return
     # NOTE: must not touch jax.devices()/process_count() here — that would
     # initialize the backend single-process and make distributed init impossible
@@ -408,7 +426,11 @@ class AcceleratorState:
         if not isinstance(plugins, dict):
             plugins = {"default": plugins}
         self._shared_state["deepspeed_plugins"] = plugins
-        self._shared_state.setdefault("active_deepspeed_plugin", next(iter(plugins)))
+        # re-registering under different names must not leave a stale active
+        # name pointing outside the new registry (deepspeed_plugin would
+        # silently return None)
+        if self._shared_state.get("active_deepspeed_plugin") not in plugins:
+            self._shared_state["active_deepspeed_plugin"] = next(iter(plugins))
 
     def get_deepspeed_plugin(self, name: str):
         """Look up a registered plugin by name (reference `get_deepspeed_plugin`)."""

@@ -179,6 +179,74 @@ def test_sbatch_batch_step_stays_local(monkeypatch):
     assert calls == []
 
 
+def _slurm_step_env(monkeypatch):
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "ACCELERATE_TPU_NUM_PROCESSES", "JAX_PROCESS_ID",
+              "ACCELERATE_TPU_ALLOW_SLURM_FALLBACK"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("SLURM_JOB_ID", "4242")
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    monkeypatch.setenv("SLURM_STEP_NUM_TASKS", "4")
+
+
+def test_slurm_step_init_failure_raises(monkeypatch):
+    """A failed distributed init inside a multi-task srun step must REFUSE to
+    continue: the old silent fallback ran N duplicate single-process worlds
+    that all claimed main-process and overwrote each other's outputs."""
+    from accelerate_tpu import state as st
+
+    _slurm_step_env(monkeypatch)
+    monkeypatch.setattr(st.jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
+
+    def boom(**kw):
+        raise RuntimeError("no coordinator")
+
+    monkeypatch.setattr(st.jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError, match="ALLOW_SLURM_FALLBACK"):
+        st._maybe_init_distributed()
+
+
+def test_slurm_step_init_failure_fallback_opt_out(monkeypatch):
+    """ACCELERATE_TPU_ALLOW_SLURM_FALLBACK=1 restores the old warn-and-continue
+    behavior for salvage debugging."""
+    from accelerate_tpu import state as st
+
+    _slurm_step_env(monkeypatch)
+    monkeypatch.setenv("ACCELERATE_TPU_ALLOW_SLURM_FALLBACK", "1")
+    monkeypatch.setattr(st.jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
+
+    def boom(**kw):
+        raise RuntimeError("no coordinator")
+
+    monkeypatch.setattr(st.jax.distributed, "initialize", boom)
+    st._maybe_init_distributed()  # must not raise
+
+
+def test_reregistering_deepspeed_plugins_resets_stale_active(monkeypatch):
+    """Re-registering under new names must re-point the active plugin at the
+    new dict's first entry, not leave deepspeed_plugin silently None."""
+    from accelerate_tpu import state as st
+    from accelerate_tpu.state import AcceleratorState
+
+    # this jax version lacks jax.distributed.is_initialized (the construction
+    # path probes it); stub it so the test exercises the registry, not the env
+    monkeypatch.setattr(st.jax.distributed, "is_initialized", lambda: True,
+                        raising=False)
+    AcceleratorState._reset_state()
+    st = AcceleratorState()
+    a, b, c = object(), object(), object()
+    st.register_deepspeed_plugins({"train": a, "eval": b})
+    st.select_deepspeed_plugin("eval")
+    st.register_deepspeed_plugins({"prod": c})  # "eval" is now stale
+    assert st.deepspeed_plugin is c
+    # re-registering with the active name still present keeps the selection
+    st.register_deepspeed_plugins({"other": a, "prod": c})
+    assert st.deepspeed_plugin is c
+    AcceleratorState._reset_state()
+
+
 def test_sagemaker_env_noop_outside_sagemaker(monkeypatch):
     from accelerate_tpu.state import _sagemaker_env_to_contract
 

@@ -168,7 +168,10 @@ def _run_window(out_path: str, root: str, done: set[str]) -> bool:
             print("[watch] relay re-wedged after sweep; pausing window", flush=True)
             return False
     time.sleep(SETTLE_S)
-    _prewarm_checkpoint_cache()
+    if not {"inf_fp16", "inf_nf4"} <= done:
+        # both inference phases finished in an earlier window: re-reading the
+        # multi-GB checkpoint would be pure wasted IO on a resumed window
+        _prewarm_checkpoint_cache()
     for quant in ("", "nf4"):
         phase = f"inf_{quant or 'fp16'}"
         if phase in done:
@@ -230,27 +233,29 @@ def _run_window(out_path: str, root: str, done: set[str]) -> bool:
             print("[watch] relay re-wedged during profile; pausing window", flush=True)
             return False
         done.add("profile")
-    # nf4 kernel-vs-XLA micro-timings: the go/no-go data for wiring the fused
-    # dequant-matmul into the decode loop (docs/PERF_NOTES.md round-4 queue)
-    print("[watch] nf4 kernel microbench", flush=True)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = root
-    stdout, stderr_tail = _run_salvaging(
-        [sys.executable, os.path.join(root, "tools", "bench_nf4_kernel.py")], env
-    )
-    rows = []
-    for ln in stdout.strip().splitlines():
-        try:
-            rows.append(json.loads(ln))  # drops lines truncated by a mid-print kill
-        except ValueError:
-            continue
-    if not rows:
-        rows = [{"metric": "nf4_matmul_us", "error": "no-json", "stderr": stderr_tail[:200]}]
-    with open(out_path, "a") as f:
-        for rec in rows:
-            f.write(json.dumps(rec) + "\n")
-    done.add("nf4_micro")
-    print(f"[watch] nf4 microbench rows: {len(rows)}", flush=True)
+    if "nf4_micro" not in done:
+        # nf4 kernel-vs-XLA micro-timings: the go/no-go data for wiring the fused
+        # dequant-matmul into the decode loop (docs/PERF_NOTES.md round-4 queue)
+        print("[watch] nf4 kernel microbench", flush=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root
+        stdout, stderr_tail = _run_salvaging(
+            [sys.executable, os.path.join(root, "tools", "bench_nf4_kernel.py")], env
+        )
+        rows = []
+        for ln in stdout.strip().splitlines():
+            try:
+                rows.append(json.loads(ln))  # drops lines truncated by a mid-print kill
+            except ValueError:
+                continue
+        if not rows:
+            rows = [{"metric": "nf4_matmul_us", "error": "no-json",
+                     "stderr": stderr_tail[:200]}]
+        with open(out_path, "a") as f:
+            for rec in rows:
+                f.write(json.dumps(rec) + "\n")
+        done.add("nf4_micro")
+        print(f"[watch] nf4 microbench rows: {len(rows)}", flush=True)
     if "examples" not in done:
         # BASELINE 'targets to measure': nlp_example samples/s/chip +
         # cv_example images/s/chip (configs[0]/[1])
