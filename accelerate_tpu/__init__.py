@@ -33,6 +33,14 @@ from .parallel.mesh import ParallelismConfig, build_mesh
 from .parallel.pipeline import pipeline_apply, stack_stage_params
 from .parallel.ring_attention import ring_attention, ring_attention_sharded
 from .parallel.sharding import ShardingRules, infer_param_shardings
+from .reliability import (
+    FaultInjector,
+    FaultSpec,
+    PreemptionHandler,
+    RetryError,
+    RetryPolicy,
+    install_preemption_handler,
+)
 from .scheduler import AcceleratedScheduler, OptaxSchedule
 from .serving import (
     FIFOScheduler,

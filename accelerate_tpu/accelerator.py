@@ -1608,13 +1608,18 @@ class Accelerator:
 
         wait_for_checkpoint_saves()
 
-    def load_state(self, input_dir: str | None = None, **load_model_kwargs: Any) -> None:
+    def load_state(self, input_dir: str | None = None, **load_model_kwargs: Any) -> str:
+        """With ``input_dir=None``, recovery walks the complete-checkpoint
+        chain newest-first and restores from the first directory that loads
+        cleanly (a corrupt latest checkpoint falls back instead of failing) —
+        pre-hooks observe the newest candidate. Returns the directory actually
+        restored."""
         from .checkpointing import latest_checkpoint_dir, load_accelerator_state
 
         resolved = str(latest_checkpoint_dir(self)) if input_dir is None else str(input_dir)
         for hook in self._load_state_pre_hooks.values():
             hook(self._models, resolved)
-        load_accelerator_state(self, resolved)
+        return load_accelerator_state(self, input_dir)
 
     def save_model(
         self,

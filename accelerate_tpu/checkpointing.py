@@ -19,14 +19,22 @@ import atexit
 import os
 import pickle
 import shutil
+import warnings
 from pathlib import Path
 from typing import Any
 
 import jax
 import numpy as np
 
+from .reliability.faults import (
+    SCOPE_CHECKPOINT_RESTORE,
+    SCOPE_CHECKPOINT_SAVE,
+    fault_point,
+)
+from .reliability.retry import RetryPolicy
 from .state import PartialState
 from .utils.constants import (
+    CHECKPOINT_COMPLETE_MARKER,
     CHECKPOINT_DIR_PREFIX,
     CUSTOM_STATE_NAME,
     DATALOADER_STATE_NAME,
@@ -51,6 +59,47 @@ def _ocp():
 # background thread; ``close`` joins it). SURVEY §7.6 async sharded save.
 _PENDING_SAVES: list[Any] = []
 
+# Checkpoint dirs awaiting their _COMPLETE commit marker: an async generation
+# is committed only once wait_for_checkpoint_saves() has joined every writer
+# without error. At most one generation is in flight (save barriers at entry).
+_PENDING_COMMITS: list[Path] = []
+
+# Transient-I/O retry for every save/restore touchpoint (docs/reliability.md).
+# Module-level so deployments can swap in a tighter/looser policy.
+CHECKPOINT_RETRY_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                      max_delay_s=1.0, retryable=(OSError,))
+
+
+class CheckpointSaveError(Exception):
+    """One or more async checkpoint writers failed; ``errors`` holds every
+    underlying exception (the whole pending list is drained regardless)."""
+
+    def __init__(self, errors: list[BaseException]):
+        super().__init__(
+            f"{len(errors)} async checkpoint writer(s) failed: "
+            + "; ".join(repr(e) for e in errors)
+        )
+        self.errors = errors
+
+
+class CheckpointRestoreError(Exception):
+    """Every complete checkpoint in the fallback chain failed to restore;
+    ``errors`` holds the per-checkpoint failures newest-first."""
+
+    def __init__(self, errors: list[BaseException]):
+        super().__init__(
+            f"all {len(errors)} complete checkpoint(s) failed to restore: "
+            + "; ".join(repr(e) for e in errors)
+        )
+        self.errors = errors
+
+
+def _commit_checkpoint(d: Path) -> None:
+    """Land the `_COMPLETE` marker — the crash-consistency line: a directory
+    without it is treated as torn and skipped by `latest_checkpoint_dir`."""
+    if PartialState().is_main_process:
+        (d / CHECKPOINT_COMPLETE_MARKER).write_text("complete\n")
+
 
 def wait_for_checkpoint_saves() -> None:
     """Barrier: block until every scheduled async save has fully landed on disk.
@@ -58,13 +107,34 @@ def wait_for_checkpoint_saves() -> None:
     Called automatically before the next save (so directory rotation can't
     delete a checkpoint mid-write), before any restore, and at process exit —
     the reference's synchronous ``save_state`` semantics are thus preserved at
-    every point where they are observable."""
+    every point where they are observable.
+
+    The WHOLE pending list is drained and every saver closed even when one
+    ``wait_until_finished`` raises (a partial drain would leak writer threads
+    and orphan savers); failures re-raise aggregated as `CheckpointSaveError`.
+    Only after an error-free drain are pending generations committed with
+    their `_COMPLETE` marker."""
+    errors: list[BaseException] = []
     while _PENDING_SAVES:
         ckptr = _PENDING_SAVES.pop()
         try:
             ckptr.wait_until_finished()
+        except BaseException as exc:
+            errors.append(exc)
         finally:
-            ckptr.close()
+            try:
+                ckptr.close()
+            except BaseException as exc:
+                errors.append(exc)
+    if errors:
+        # the in-flight generation may be torn — leave it uncommitted so
+        # recovery falls back to the previous intact checkpoint
+        _PENDING_COMMITS.clear()
+        if len(errors) == 1:
+            raise errors[0]
+        raise CheckpointSaveError(errors)
+    while _PENDING_COMMITS:
+        _commit_checkpoint(_PENDING_COMMITS.pop())
 
 
 atexit.register(wait_for_checkpoint_saves)
@@ -74,11 +144,28 @@ def _save_pytree(path: Path, tree: Any, async_save: bool = False) -> None:
     ocp = _ocp()
     if async_save:
         ckptr = ocp.StandardCheckpointer()
-        ckptr.save(path.absolute(), tree)
+
+        def _schedule():
+            fault_point(SCOPE_CHECKPOINT_SAVE)
+            ckptr.save(path.absolute(), tree)
+
+        try:
+            # retries cover the synchronous device->host + scheduling half of
+            # the async save; background-write failures surface (aggregated)
+            # at the next wait_for_checkpoint_saves() barrier
+            CHECKPOINT_RETRY_POLICY.call(_schedule)
+        except BaseException:
+            ckptr.close()
+            raise
         _PENDING_SAVES.append(ckptr)
         return
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path.absolute(), tree)
+
+    def _save():
+        fault_point(SCOPE_CHECKPOINT_SAVE)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path.absolute(), tree)
+
+    CHECKPOINT_RETRY_POLICY.call(_save)
 
 
 def _restore_pytree(path: Path, target: Any | None = None) -> Any:
@@ -101,16 +188,20 @@ def _restore_pytree(path: Path, target: Any | None = None) -> Any:
         # restore such leaves replicated on the mesh instead.
         return NamedSharding(mesh, PartitionSpec())
 
-    with ocp.StandardCheckpointer() as ckptr:
-        if target is None:
-            return ckptr.restore(path.absolute())
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding_for(x))
-            if hasattr(x, "shape")
-            else x,
-            target,
-        )
-        return ckptr.restore(path.absolute(), abstract)
+    def _restore():
+        fault_point(SCOPE_CHECKPOINT_RESTORE)
+        with ocp.StandardCheckpointer() as ckptr:
+            if target is None:
+                return ckptr.restore(path.absolute())
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding_for(x))
+                if hasattr(x, "shape")
+                else x,
+                target,
+            )
+            return ckptr.restore(path.absolute(), abstract)
+
+    return CHECKPOINT_RETRY_POLICY.call(_restore)
 
 
 def _restore_pytree_host(path: Path) -> Any:
@@ -135,14 +226,24 @@ def _restore_pytree_host(path: Path) -> Any:
 
 
 def _save_host_state(path: Path, obj: Any) -> None:
-    if PartialState().is_main_process:
+    if not PartialState().is_main_process:
+        return
+
+    def _write():
+        fault_point(SCOPE_CHECKPOINT_SAVE)
         with open(path, "wb") as f:
             pickle.dump(obj, f)
 
+    CHECKPOINT_RETRY_POLICY.call(_write)
+
 
 def _load_host_state(path: Path) -> Any:
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    def _read():
+        fault_point(SCOPE_CHECKPOINT_RESTORE)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    return CHECKPOINT_RETRY_POLICY.call(_read)
 
 
 def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
@@ -170,6 +271,9 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
             for stale in existing[: len(existing) + 1 - pc.total_limit]:
                 if PartialState().is_main_process:
                     shutil.rmtree(stale, ignore_errors=True)
+            # non-main processes must not proceed (and possibly start reading
+            # a checkpoint for restore) while main's rmtree is mid-deletion
+            PartialState().wait_for_everyone()
         target = base / f"{CHECKPOINT_DIR_PREFIX}_{pc.iteration}"
         pc.iteration += 1
         return target
@@ -177,25 +281,36 @@ def get_checkpoint_dir(accelerator, output_dir: str | None) -> Path:
 
 
 def _is_complete_checkpoint(d: Path) -> bool:
-    """A preemption/SIGKILL between an async save_state returning and its
-    background writes committing leaves orbax's atomic-rename temp dirs
-    (``*.orbax-checkpoint-tmp-*``) next to — instead of — the final array
-    dirs. Such a directory must not be offered to load_state(None): automatic
-    recovery should fall back to the previous intact checkpoint."""
+    """A preemption/SIGKILL anywhere between a save_state starting and its
+    last background write committing leaves a torn directory: orbax's
+    atomic-rename temp dirs (``*.orbax-checkpoint-tmp-*``) next to — instead
+    of — the final array dirs, or host-state pickles with no array dirs at
+    all. The `_COMPLETE` marker is written strictly AFTER every array and
+    host write has landed, so its presence (plus the absence of temp dirs)
+    is the commit line: anything else must not be offered to
+    load_state(None) — automatic recovery falls back to the previous intact
+    checkpoint."""
     try:
         entries = list(d.iterdir())
     except OSError:
         return False
-    return bool(entries) and not any("orbax-checkpoint-tmp" in e.name for e in entries)
+    if not entries or any("orbax-checkpoint-tmp" in e.name for e in entries):
+        return False
+    return (d / CHECKPOINT_COMPLETE_MARKER).exists()
 
 
-def latest_checkpoint_dir(accelerator) -> Path:
-    """Most recent COMPLETE automatic checkpoint directory (for load_state(None));
-    directories left incomplete by a crash mid-async-write are skipped."""
+def complete_checkpoint_dirs(accelerator) -> list[Path]:
+    """Every COMPLETE automatic checkpoint directory, newest first — the
+    restore fallback chain for load_accelerator_state(None). Torn directories
+    (crashed mid-write, see `_is_complete_checkpoint`) are excluded; bit-rot a
+    completeness scan cannot see (e.g. a truncated array file) is caught when
+    the restore itself fails and the chain walks to the next entry."""
     wait_for_checkpoint_saves()  # our own in-flight saves must not look crashed
     pc = accelerator.project_configuration
     base = Path(pc.project_dir or ".") / "checkpoints"
-    candidates = sorted(
+    if not base.exists():
+        return []
+    return sorted(
         (
             d
             for d in base.iterdir()
@@ -204,10 +319,18 @@ def latest_checkpoint_dir(accelerator) -> Path:
             and _is_complete_checkpoint(d)
         ),
         key=lambda d: int(d.name.rsplit("_", 1)[1]),
-    ) if base.exists() else []
+        reverse=True,
+    )
+
+
+def latest_checkpoint_dir(accelerator) -> Path:
+    """Most recent COMPLETE automatic checkpoint directory (for load_state(None));
+    directories left incomplete by a crash mid-async-write are skipped."""
+    candidates = complete_checkpoint_dirs(accelerator)
     if not candidates:
+        base = Path(accelerator.project_configuration.project_dir or ".") / "checkpoints"
         raise FileNotFoundError(f"No complete checkpoints under {base}")
-    return candidates[-1]
+    return candidates[0]
 
 
 def save_accelerator_state(
@@ -254,16 +377,46 @@ def save_accelerator_state(
     _save_host_state(out / f"{RNG_STATE_NAME}.pkl", capture_rng_state())
     _save_host_state(out / f"{STEP_STATE_NAME}.pkl", {"step": accelerator.step})
     state.wait_for_everyone()
+    if async_save:
+        # the generation commits (gets its _COMPLETE marker) only when the
+        # background writers are joined error-free at the next barrier
+        _PENDING_COMMITS.append(out)
+    else:
+        _commit_checkpoint(out)
     return str(out)
 
 
-def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
+def load_accelerator_state(accelerator, input_dir: str | None = None) -> str:
     """Restore every prepared object (reference `checkpointing.py:165-286`).
-    Sharded arrays are re-placed directly onto their mesh positions."""
-    if input_dir is None:
-        input_dir = str(latest_checkpoint_dir(accelerator))
-    src = Path(input_dir)
+    Sharded arrays are re-placed directly onto their mesh positions.
 
+    With ``input_dir=None`` this is the crash-recovery entry point: it walks
+    the complete-checkpoint chain newest-first and restores from the first
+    directory that loads cleanly — a latest checkpoint corrupted past what
+    the completeness scan can see (truncated array file, unreadable pickle)
+    is skipped instead of killing recovery. Returns the directory actually
+    restored from."""
+    if input_dir is None:
+        candidates = complete_checkpoint_dirs(accelerator)
+        if not candidates:
+            base = Path(accelerator.project_configuration.project_dir or ".") / "checkpoints"
+            raise FileNotFoundError(f"No complete checkpoints under {base}")
+        failures: list[BaseException] = []
+        for candidate in candidates:
+            try:
+                return _load_accelerator_state_from(accelerator, candidate)
+            except Exception as exc:  # corrupt/unreadable: walk back one
+                failures.append(exc)
+                warnings.warn(
+                    f"checkpoint {candidate} failed to restore ({exc!r}); "
+                    "falling back to the previous complete checkpoint",
+                    stacklevel=2,
+                )
+        raise CheckpointRestoreError(failures)
+    return _load_accelerator_state_from(accelerator, Path(input_dir))
+
+
+def _load_accelerator_state_from(accelerator, src: Path) -> str:
     for i, model in enumerate(accelerator._models):
         model.params = _restore_pytree(src / f"{MODEL_NAME}_{i}", target=model.params)
         extra_path = src / f"{MODEL_NAME}_{i}.extra"
@@ -286,6 +439,7 @@ def load_accelerator_state(accelerator, input_dir: str | None = None) -> None:
     step_path = src / f"{STEP_STATE_NAME}.pkl"
     if step_path.exists():
         accelerator.step = _load_host_state(step_path)["step"]
+    return str(src)
 
 
 def save_custom_state(obj: Any, path: str | os.PathLike, index: int = 0) -> str:

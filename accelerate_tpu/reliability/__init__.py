@@ -1,0 +1,47 @@
+"""Reliability layer (`docs/reliability.md`): deterministic fault injection,
+retry-with-backoff, and SIGTERM preemption handling.
+
+At the ROADMAP's production scale, preemptions and transient I/O failures are
+routine; this package supplies (a) the seeded `FaultInjector` that every
+recovery path is proven against in tests, (b) the `RetryPolicy` those paths
+share, and (c) the opt-in `PreemptionHandler` that lands a synchronous
+checkpoint inside a SIGTERM grace window. The serving watchdog and the
+checkpoint commit-marker / restore-fallback machinery consume these from
+`serving/engine.py` and `checkpointing.py`.
+"""
+
+from .faults import (
+    ALL_SLOTS,
+    SCOPE_CHECKPOINT_RESTORE,
+    SCOPE_CHECKPOINT_SAVE,
+    SCOPE_PREEMPTION,
+    SCOPE_SERVING_DECODE,
+    FaultEvent,
+    FaultInjector,
+    FaultSpec,
+    TransientIOError,
+    active_injector,
+    fault_point,
+    inject,
+)
+from .preemption import PreemptionHandler, install_preemption_handler
+from .retry import RetryError, RetryPolicy
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "FaultEvent",
+    "TransientIOError",
+    "active_injector",
+    "inject",
+    "fault_point",
+    "ALL_SLOTS",
+    "SCOPE_CHECKPOINT_SAVE",
+    "SCOPE_CHECKPOINT_RESTORE",
+    "SCOPE_SERVING_DECODE",
+    "SCOPE_PREEMPTION",
+    "RetryPolicy",
+    "RetryError",
+    "PreemptionHandler",
+    "install_preemption_handler",
+]

@@ -1,0 +1,218 @@
+"""Deterministic, scoped fault injection (`docs/reliability.md`).
+
+At production scale (the ROADMAP north star) TPU preemptions, transient I/O
+errors, and poisoned decode steps are routine events, not exceptions. Every
+recovery path in this repo — checkpoint retry, restore fallback, the serving
+watchdog, the preemption handler — is therefore proven under *injected* faults
+rather than waiting for real ones. The injector is:
+
+- **seeded**: every decision (scheduled or probabilistic) derives from
+  ``(seed, scope)``, so a failing chaos run replays bit-identically;
+- **scoped**: faults fire only at named fault points (``checkpoint.save``,
+  ``checkpoint.restore``, ``serving.decode``, ``preemption``) — the rest of
+  the system is untouched;
+- **zero-cost when inactive**: production fault points are one module-global
+  ``None`` check (`fault_point`), nothing else.
+
+Activation is lexical, via the ``inject`` context manager::
+
+    inj = FaultInjector(seed=7, specs=[FaultSpec.io_error("checkpoint.save", at_calls=(0,))])
+    with inject(inj):
+        accelerator.save_state(...)   # first save attempt raises TransientIOError
+    assert inj.fired  # the fault log records (scope, call index, kind)
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import zlib
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+import numpy as np
+
+# canonical fault-point names (callers may define their own scopes freely)
+SCOPE_CHECKPOINT_SAVE = "checkpoint.save"
+SCOPE_CHECKPOINT_RESTORE = "checkpoint.restore"
+SCOPE_SERVING_DECODE = "serving.decode"
+SCOPE_PREEMPTION = "preemption"
+
+# fault kinds
+KIND_IO_ERROR = "io_error"
+KIND_POISON_NAN = "poison_nan"
+KIND_PREEMPT = "preempt"
+
+# sentinel: a poison spec with no explicit slots poisons every active slot
+ALL_SLOTS: tuple[int, ...] = ()
+
+
+class TransientIOError(OSError):
+    """The injected stand-in for a transient storage failure (flaky NFS/GCS,
+    preempted writer, ...). An ``OSError`` subclass on purpose: the default
+    `retry.RetryPolicy` retryable filter catches exactly what real transient
+    I/O raises, so injected and organic faults exercise the same path."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled or probabilistic fault at one scope.
+
+    ``at_calls`` fires at exact 0-based call indices of the scope's fault
+    point (fully deterministic); ``probability`` fires by a seeded per-spec
+    Bernoulli stream (deterministic given the injector seed). ``max_faults``
+    caps total firings; ``slots`` narrows a poison fault to specific serving
+    slots (empty = all active slots).
+    """
+
+    scope: str
+    kind: str
+    at_calls: tuple[int, ...] = ()
+    probability: float = 0.0
+    max_faults: int | None = None
+    slots: tuple[int, ...] = ALL_SLOTS
+
+    @classmethod
+    def io_error(cls, scope: str, at_calls: Sequence[int] = (),
+                 probability: float = 0.0, max_faults: int | None = None) -> "FaultSpec":
+        return cls(scope, KIND_IO_ERROR, tuple(at_calls), probability, max_faults)
+
+    @classmethod
+    def poison(cls, at_steps: Sequence[int] = (), probability: float = 0.0,
+               slots: Sequence[int] = ALL_SLOTS, max_faults: int | None = None,
+               scope: str = SCOPE_SERVING_DECODE) -> "FaultSpec":
+        return cls(scope, KIND_POISON_NAN, tuple(at_steps), probability,
+                   max_faults, tuple(slots))
+
+    @classmethod
+    def preempt(cls, at_calls: Sequence[int] = (), probability: float = 0.0,
+                scope: str = SCOPE_PREEMPTION) -> "FaultSpec":
+        return cls(scope, KIND_PREEMPT, tuple(at_calls), probability, max_faults=1)
+
+
+@dataclass
+class FaultEvent:
+    """One firing, recorded in `FaultInjector.fired` for assertions/replay."""
+
+    scope: str
+    call_index: int
+    kind: str
+    slots: tuple[int, ...] = ALL_SLOTS
+
+
+class FaultInjector:
+    """Seeded, scoped fault source. Thread-compatible for the single-writer
+    pattern the engine and checkpointing use (one host thread hits each
+    scope); not a general concurrent primitive."""
+
+    def __init__(self, seed: int = 0, specs: Sequence[FaultSpec] = ()):
+        self.seed = int(seed)
+        self.specs = tuple(specs)
+        self._calls: dict[str, int] = {}
+        self._spec_fired: dict[int, int] = {}
+        self._spec_rng: dict[int, np.random.Generator] = {}
+        self.fired: list[FaultEvent] = []
+
+    # ------------------------------------------------------------- internals
+    def _rng_for(self, spec_idx: int, spec: FaultSpec) -> np.random.Generator:
+        rng = self._spec_rng.get(spec_idx)
+        if rng is None:
+            # a per-spec substream keyed on (seed, scope, kind, position):
+            # adding a spec never perturbs another spec's draw sequence
+            rng = np.random.default_rng(
+                [self.seed, zlib.crc32(spec.scope.encode()),
+                 zlib.crc32(spec.kind.encode()), spec_idx]
+            )
+            self._spec_rng[spec_idx] = rng
+        return rng
+
+    def _matching(self, scope: str, kinds: tuple[str, ...], call_idx: int
+                  ) -> Iterator[tuple[int, FaultSpec]]:
+        """Specs of ``kinds`` at ``scope`` that fire at this call index.
+        Probability draws happen for every matching call so the stream is a
+        pure function of the call sequence, not of prior firings."""
+        for i, spec in enumerate(self.specs):
+            if spec.scope != scope or spec.kind not in kinds:
+                continue
+            fires = call_idx in spec.at_calls
+            if spec.probability > 0.0:
+                draw = float(self._rng_for(i, spec).random())
+                fires = fires or draw < spec.probability
+            if not fires:
+                continue
+            if spec.max_faults is not None and self._spec_fired.get(i, 0) >= spec.max_faults:
+                continue
+            self._spec_fired[i] = self._spec_fired.get(i, 0) + 1
+            yield i, spec
+
+    def _tick(self, scope: str) -> int:
+        idx = self._calls.get(scope, 0)
+        self._calls[scope] = idx + 1
+        return idx
+
+    # ------------------------------------------------------------ fault points
+    def maybe_raise(self, scope: str) -> None:
+        """I/O fault point: raise `TransientIOError` when a spec fires."""
+        idx = self._tick(scope)
+        for _, spec in self._matching(scope, (KIND_IO_ERROR,), idx):
+            self.fired.append(FaultEvent(scope, idx, KIND_IO_ERROR))
+            raise TransientIOError(f"injected transient I/O fault at {scope}#{idx}")
+
+    def poison_slots(self, scope: str = SCOPE_SERVING_DECODE) -> tuple[int, ...] | None:
+        """Decode-step fault point: the slots to poison with NaN logits this
+        step, or ``None`` when no spec fires. An empty tuple (the `ALL_SLOTS`
+        sentinel) means every active slot. Each call advances the scope's
+        step counter, so ``at_steps`` indexes the engine's decode steps."""
+        idx = self._tick(scope)
+        hit: tuple[int, ...] | None = None
+        for _, spec in self._matching(scope, (KIND_POISON_NAN,), idx):
+            self.fired.append(FaultEvent(scope, idx, KIND_POISON_NAN, spec.slots))
+            hit = spec.slots if hit is None else tuple(sorted({*hit, *spec.slots}))
+            if spec.slots == ALL_SLOTS:
+                hit = ALL_SLOTS
+        return hit
+
+    def maybe_preempt(self, scope: str = SCOPE_PREEMPTION) -> bool:
+        """Preemption fault point: deliver a real ``SIGTERM`` to this process
+        when a spec fires (exercising the installed `preemption` handler the
+        way a TPU-VM maintenance event would). Returns whether it fired."""
+        idx = self._tick(scope)
+        for _, spec in self._matching(scope, (KIND_PREEMPT,), idx):
+            self.fired.append(FaultEvent(scope, idx, KIND_PREEMPT))
+            os.kill(os.getpid(), signal.SIGTERM)
+            return True
+        return False
+
+    def calls(self, scope: str) -> int:
+        """How many times ``scope``'s fault point has been evaluated."""
+        return self._calls.get(scope, 0)
+
+
+# --------------------------------------------------------------- activation
+_ACTIVE: FaultInjector | None = None
+
+
+def active_injector() -> FaultInjector | None:
+    """The injector currently activated by `inject`, or None (production)."""
+    return _ACTIVE
+
+
+@contextmanager
+def inject(injector: FaultInjector):
+    """Activate ``injector`` for the dynamic extent of the block (nestable;
+    the previous injector is restored on exit)."""
+    global _ACTIVE
+    previous = _ACTIVE
+    _ACTIVE = injector
+    try:
+        yield injector
+    finally:
+        _ACTIVE = previous
+
+
+def fault_point(scope: str) -> None:
+    """Production hook: raise an injected I/O fault if an active injector
+    schedules one here; a no-op (one global load) otherwise."""
+    if _ACTIVE is not None:
+        _ACTIVE.maybe_raise(scope)

@@ -1,0 +1,93 @@
+"""Opt-in SIGTERM preemption handling (`docs/reliability.md`).
+
+TPU-VM maintenance events and spot reclamation deliver ``SIGTERM`` with a
+short grace window; the restart-from-checkpoint recovery loop (the baseline
+failure model SimpleFSDP/GSPMD-style compiled stacks assume) only works if a
+checkpoint actually lands inside that window. `PreemptionHandler` installs a
+handler that writes a **synchronous** checkpoint (async would race the kill)
+and then exits — or chains to whatever handler was installed before it.
+
+Opt-in by construction: nothing installs it implicitly; a library must never
+steal a host application's signal disposition.
+
+    handler = install_preemption_handler(accelerator)
+    ...training loop...            # SIGTERM now checkpoints before exit
+    handler.uninstall()            # restore the previous disposition
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Any
+
+# conventional exit status for "terminated by SIGTERM" (128 + 15)
+SIGTERM_EXIT_CODE = 143
+
+
+class PreemptionHandler:
+    """SIGTERM -> synchronous ``save_state`` -> exit (or chain).
+
+    ``exit_on_preempt=False`` turns the handler into a checkpoint-and-continue
+    hook (useful under test, or when an outer supervisor owns process death);
+    ``preempted``/``checkpoint_dir`` record what happened either way.
+    """
+
+    def __init__(
+        self,
+        accelerator: Any,
+        output_dir: str | None = None,
+        *,
+        exit_on_preempt: bool = True,
+        exit_code: int = SIGTERM_EXIT_CODE,
+    ):
+        self.accelerator = accelerator
+        self.output_dir = output_dir
+        self.exit_on_preempt = exit_on_preempt
+        self.exit_code = exit_code
+        self.preempted = False
+        self.checkpoint_dir: str | None = None
+        self._previous: Any = None
+        self._installed = False
+
+    def install(self) -> "PreemptionHandler":
+        """Register on ``SIGTERM`` (main thread only — CPython restriction),
+        keeping the previous disposition for chaining/uninstall."""
+        if self._installed:
+            return self
+        self._previous = signal.signal(signal.SIGTERM, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the pre-install SIGTERM disposition."""
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._previous or signal.SIG_DFL)
+            self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        # checkpointing is imported lazily: checkpointing.py itself imports
+        # this package (retry/fault points), so a module-level import here
+        # would be circular
+        from ..checkpointing import wait_for_checkpoint_saves
+
+        self.preempted = True
+        try:
+            # synchronous on purpose: the grace window ends in seconds and an
+            # async save's background writer would die with the process
+            self.checkpoint_dir = self.accelerator.save_state(
+                self.output_dir, async_save=False
+            )
+            wait_for_checkpoint_saves()
+        finally:
+            previous = self._previous
+            if callable(previous):
+                previous(signum, frame)
+            elif self.exit_on_preempt:
+                raise SystemExit(self.exit_code)
+
+
+def install_preemption_handler(
+    accelerator: Any, output_dir: str | None = None, **kwargs: Any
+) -> PreemptionHandler:
+    """Install and return a `PreemptionHandler` (see class docs for knobs)."""
+    return PreemptionHandler(accelerator, output_dir, **kwargs).install()

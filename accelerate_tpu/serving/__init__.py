@@ -9,8 +9,12 @@ counters/histograms exported through the `tracking.py` tracker interface.
 from .engine import ServingEngine
 from .metrics import Counter, Histogram, ServingMetrics
 from .request import (
+    FINISH_ABORTED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
     REJECT_PROMPT_TOO_LONG,
     REJECT_QUEUE_FULL,
     Request,
@@ -32,6 +36,10 @@ __all__ = [
     "SubmitResult",
     "FINISH_EOS",
     "FINISH_LENGTH",
+    "FINISH_ABORTED",
+    "FINISH_ERROR",
     "REJECT_QUEUE_FULL",
     "REJECT_PROMPT_TOO_LONG",
+    "REJECT_DEADLINE",
+    "REJECT_DRAINING",
 ]

@@ -41,10 +41,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..reliability.faults import ALL_SLOTS, active_injector
 from .metrics import ServingMetrics
 from .request import (
+    FINISH_ABORTED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
     REJECT_QUEUE_FULL,
     Request,
     RequestOutput,
@@ -164,6 +169,8 @@ class ServingEngine:
         self._free: deque[int] = deque(range(b))
         self._next_id = 0
         self._step_count = 0
+        self._vocab = int(getattr(module.config, "vocab_size", 0) or 0)
+        self._draining = False
         self._step_fn = self._build_step_fn()
         self._admit_fn = self._build_admit_fn()
 
@@ -171,16 +178,25 @@ class ServingEngine:
     def _build_step_fn(self):
         module = self.module
 
-        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data):
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data, poison):
             logits, mutated = module.apply(
                 {"params": params, "cache": cache}, tokens[:, None], decode=True,
                 position_offset=pos, mutable=["cache"],
             )
+            last = logits[:, -1]
+            # fault injection rides INSIDE the compiled step (poison is a [b]
+            # data mask, all-False in production): NaN logits flow through the
+            # real sampler so the watchdog sees exactly what a numerically
+            # poisoned model step would produce
+            last = jnp.where(poison[:, None], jnp.asarray(jnp.nan, last.dtype), last)
+            # watchdog health flag: a non-finite logit row means this slot's
+            # sampled token is garbage, whatever index it lands on
+            ok = jnp.all(jnp.isfinite(last), axis=-1)
             rngs = jax.random.wrap_key_data(rng_data)
             split = jax.vmap(jax.random.split)(rngs)  # [b, 2] keys
             new_rngs, keys = split[:, 0], split[:, 1]
-            nxt = jax.vmap(_sample_slot)(logits[:, -1], keys, temps, top_ks)
-            return mutated["cache"], nxt, jax.random.key_data(new_rngs)
+            nxt = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            return mutated["cache"], nxt, jax.random.key_data(new_rngs), ok
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
@@ -236,6 +252,10 @@ class ServingEngine:
         if request.arrival_time is None:
             request.arrival_time = time.perf_counter()
         self.metrics.mark_start()
+        if self._draining:
+            self.metrics.requests_rejected.inc()
+            return SubmitResult(False, request.request_id, REJECT_DRAINING,
+                                "engine is draining toward shutdown")
         result = self.scheduler.submit(request)
         if result.accepted:
             self.metrics.requests_submitted.inc()
@@ -262,17 +282,27 @@ class ServingEngine:
                                   self.scheduler.queue_depth)
         self._step_count += 1
         if n_active:
-            cache, nxt, rng_data = self._step_fn(
+            cache, nxt, rng_data, ok = self._step_fn(
                 self._cache, self.params, jnp.asarray(self._tokens),
                 jnp.asarray(self._pos), jnp.asarray(self._temps),
                 jnp.asarray(self._topks), self._rng_data,
+                jnp.asarray(self._poison_mask()),
             )
             self._cache, self._rng_data = cache, rng_data
             tokens = np.asarray(jax.device_get(nxt))
+            healthy = np.asarray(jax.device_get(ok))
             now = time.perf_counter()
+            poisoned_any = False
             for slot in np.flatnonzero(self._active):
                 slot = int(slot)
-                self._emit_token(slot, int(tokens[slot]), now, finished)
+                token = int(tokens[slot])
+                if not healthy[slot] or (self._vocab and not 0 <= token < self._vocab):
+                    poisoned_any = True
+                    self._quarantine(slot, now, finished)
+                else:
+                    self._emit_token(slot, token, now, finished)
+            if poisoned_any:
+                self.metrics.steps_poisoned.inc()
         if (self.tracker is not None and self.metrics_log_every
                 and self._step_count % self.metrics_log_every == 0):
             self.metrics.log_to(self.tracker, step=self._step_count)
@@ -284,6 +314,9 @@ class ServingEngine:
         (a queue-full rejection just defers the submit until slots drain).
         Returns outputs in submission order; structurally rejected requests
         (e.g. oversized prompts) come back with ``finish_reason='rejected:…'``.
+        Hitting ``max_steps`` aborts whatever is still active/queued with
+        `FINISH_ABORTED` and returns the partial results — completed outputs
+        are never discarded.
         """
         pending = deque(requests)
         outputs: dict[int, RequestOutput] = {}
@@ -305,12 +338,126 @@ class ServingEngine:
             for out in self.step():
                 outputs[out.request_id] = out
             steps += 1
-            if max_steps is not None and steps > max_steps:
-                raise RuntimeError(f"run() exceeded {max_steps} steps with work left")
+            if max_steps is not None and steps >= max_steps and (pending or self.has_work):
+                for out in self.abort_all():
+                    outputs[out.request_id] = out
+                while pending:  # backpressure-deferred, never entered the queue
+                    req = pending.popleft()
+                    if req.request_id is None:
+                        req.request_id = self._next_id
+                        self._next_id += 1
+                    outputs[req.request_id] = RequestOutput(
+                        request_id=req.request_id, prompt_len=len(req.prompt),
+                        tokens=[], finish_reason=FINISH_ABORTED,
+                        arrival_time=req.arrival_time,
+                    )
+                break
         return [outputs[k] for k in sorted(outputs)]
 
+    # --------------------------------------------------- lifecycle / shutdown
+    def cancel(self, request_id: int) -> RequestOutput | None:
+        """Abort one request wherever it is — queued (removed) or mid-decode
+        (slot retired with `FINISH_ABORTED`, partial tokens returned). None if
+        the id is unknown or already finished."""
+        now = time.perf_counter()
+        queued = self.scheduler.cancel(request_id)
+        if queued is not None:
+            self.metrics.requests_cancelled.inc()
+            return RequestOutput(
+                request_id=request_id, prompt_len=len(queued.prompt), tokens=[],
+                finish_reason=FINISH_ABORTED, arrival_time=queued.arrival_time,
+                finish_time=now,
+            )
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id:
+                finished: list[RequestOutput] = []
+                self._retire(slot, FINISH_ABORTED, now, finished)
+                self.metrics.requests_cancelled.inc()
+                return finished[0]
+        return None
+
+    def drain(self, max_steps: int | None = None) -> list[RequestOutput]:
+        """Graceful shutdown: stop admitting NEW submits (rejected with
+        `REJECT_DRAINING`) and serve everything already queued/active to
+        completion. ``max_steps`` bounds the wait; leftovers are aborted."""
+        self._draining = True
+        outputs: list[RequestOutput] = []
+        steps = 0
+        try:
+            while self.has_work:
+                outputs.extend(self.step())
+                steps += 1
+                if max_steps is not None and steps >= max_steps and self.has_work:
+                    outputs.extend(self.abort_all())
+                    break
+        finally:
+            self._draining = False
+        return outputs
+
+    def abort_all(self) -> list[RequestOutput]:
+        """Hard shutdown: abort every queued and active request with
+        `FINISH_ABORTED` (partial tokens kept for active ones)."""
+        now = time.perf_counter()
+        aborted: list[RequestOutput] = []
+        for req in self.scheduler.drain_queue():
+            self.metrics.requests_cancelled.inc()
+            aborted.append(RequestOutput(
+                request_id=req.request_id, prompt_len=len(req.prompt), tokens=[],
+                finish_reason=FINISH_ABORTED, arrival_time=req.arrival_time,
+                finish_time=now,
+            ))
+        for slot in np.flatnonzero(self._active):
+            self.metrics.requests_cancelled.inc()
+            self._retire(int(slot), FINISH_ABORTED, now, aborted)
+        return aborted
+
     # -------------------------------------------------------------- internals
+    def _poison_mask(self) -> np.ndarray:
+        """The [b] NaN-poison mask for this step — all-False in production;
+        an active `reliability.FaultInjector` can mark slots for poisoning
+        (its decode-step counter ticks once per step() with active slots)."""
+        mask = np.zeros(self.max_concurrency, bool)
+        injector = active_injector()
+        if injector is not None:
+            slots = injector.poison_slots()
+            if slots is not None:
+                if slots == ALL_SLOTS:
+                    mask[self._active] = True
+                else:
+                    for s in slots:
+                        if 0 <= s < self.max_concurrency and self._active[s]:
+                            mask[s] = True
+        return mask
+
+    def _quarantine(self, slot: int, now: float,
+                    finished: list[RequestOutput]) -> None:
+        """Watchdog action for a poisoned slot (non-finite logits or an
+        out-of-range sampled token): the slot's stream is garbage from this
+        step on, but every other slot is untouched — so quarantine ONLY this
+        one. First offence: free the slot and re-prefill the request from its
+        prompt (front of queue; its rng chain restarts from the seed, so the
+        replay is token-identical to an unpoisoned run). Second offence:
+        retire with `FINISH_ERROR`, keeping the engine serving healthy slots."""
+        request = self._slot_req[slot]
+        if request.retries == 0:
+            request.retries += 1
+            self.metrics.requests_retried.inc()
+            self._release_slot(slot)
+            self.scheduler.requeue(request)
+        else:
+            self._retire(slot, FINISH_ERROR, now, finished)
+
     def _admit_pending(self, finished: list[RequestOutput]) -> None:
+        now = time.perf_counter()
+        for request in self.scheduler.pop_expired(now):
+            # expired while queued: reject rather than serve a reply the
+            # client has already abandoned (REJECT_DEADLINE, never admitted)
+            self.metrics.requests_expired.inc()
+            finished.append(RequestOutput(
+                request_id=request.request_id, prompt_len=len(request.prompt),
+                tokens=[], finish_reason=f"rejected:{REJECT_DEADLINE}",
+                arrival_time=request.arrival_time, finish_time=now,
+            ))
         while self._free:
             request = self.scheduler.next_ready()
             if request is None:
@@ -374,6 +521,13 @@ class ServingEngine:
         if out.arrival_time is not None:
             self.metrics.request_latency_s.observe(max(0.0, now - out.arrival_time))
         self.metrics.requests_finished.inc()
+        self._release_slot(slot)
+        finished.append(out)
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a slot to the free pool, zeroing its per-slot data arrays
+        (the cache buffer itself needs no reset — the next admission's write
+        index restart makes the stale entries unreachable)."""
         self._slot_req[slot] = None
         self._slot_out[slot] = None
         self._active[slot] = False
@@ -383,4 +537,3 @@ class ServingEngine:
         self._topks[slot] = 0
         self._budget[slot] = 0
         self._free.append(slot)
-        finished.append(out)

@@ -91,6 +91,13 @@ class ServingMetrics:
         self.requests_submitted = Counter()
         self.requests_rejected = Counter()
         self.requests_finished = Counter()
+        # reliability counters (docs/reliability.md): queued past deadline,
+        # cancelled via cancel()/abort_all(), re-prefilled by the watchdog,
+        # and decode steps in which >= 1 slot produced poisoned output
+        self.requests_expired = Counter()
+        self.requests_cancelled = Counter()
+        self.requests_retried = Counter()
+        self.steps_poisoned = Counter()
         self.tokens_generated = Counter()
         self.prefill_tokens = Counter()
         self.steps = Counter()
@@ -123,6 +130,10 @@ class ServingMetrics:
             "serving/requests_submitted": self.requests_submitted.value,
             "serving/requests_rejected": self.requests_rejected.value,
             "serving/requests_finished": self.requests_finished.value,
+            "serving/requests_expired": self.requests_expired.value,
+            "serving/requests_cancelled": self.requests_cancelled.value,
+            "serving/requests_retried": self.requests_retried.value,
+            "serving/steps_poisoned": self.steps_poisoned.value,
             "serving/tokens_generated": self.tokens_generated.value,
             "serving/prefill_tokens": self.prefill_tokens.value,
             "serving/steps": self.steps.value,

@@ -12,11 +12,15 @@ from dataclasses import dataclass, field
 # finish reasons
 FINISH_EOS = "eos"
 FINISH_LENGTH = "length"
+FINISH_ABORTED = "aborted"  # cancelled / drained / run() step budget exhausted
+FINISH_ERROR = "error"  # watchdog: second poisoned step for the same request
 
 # rejection reason codes (SubmitResult.reason); human detail rides separately
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
 REJECT_EMPTY_PROMPT = "empty_prompt"
+REJECT_DEADLINE = "deadline"  # queued past its deadline, never admitted
+REJECT_DRAINING = "draining"  # engine is draining toward shutdown
 
 
 @dataclass(frozen=True)
@@ -37,12 +41,21 @@ class Request:
 
     ``request_id``/``arrival_time`` are stamped by `ServingEngine.submit`;
     supply ``arrival_time`` explicitly to replay a recorded trace.
+
+    ``deadline_s`` is a queue-wait budget: a request still queued
+    ``deadline_s`` seconds after arrival is expired with `REJECT_DEADLINE`
+    instead of being admitted (serving a reply the client already gave up on
+    wastes a slot). ``retries`` is stamped by the engine's step watchdog: a
+    poisoned decode step re-prefills the request once from its prompt, a
+    second poisoning retires it with `FINISH_ERROR`.
     """
 
     prompt: list[int]
     params: SamplingParams = field(default_factory=SamplingParams)
     request_id: int | None = None
     arrival_time: float | None = None
+    deadline_s: float | None = None
+    retries: int = 0
 
 
 @dataclass

@@ -71,6 +71,38 @@ class FIFOScheduler:
         """Pop the oldest queued request (FIFO), or None when idle."""
         return self._queue.popleft() if self._queue else None
 
+    def requeue(self, request: Request) -> None:
+        """Put a request at the FRONT of the queue (the watchdog's re-prefill
+        path: a quarantined request must not wait behind new arrivals)."""
+        self._queue.appendleft(request)
+
+    def pop_expired(self, now: float) -> list[Request]:
+        """Remove and return every queued request whose ``deadline_s`` queue
+        budget has elapsed (the engine rejects them with REJECT_DEADLINE)."""
+        expired = [
+            r for r in self._queue
+            if r.deadline_s is not None and r.arrival_time is not None
+            and now - r.arrival_time >= r.deadline_s
+        ]
+        if expired:
+            dead = set(map(id, expired))
+            self._queue = deque(r for r in self._queue if id(r) not in dead)
+        return expired
+
+    def cancel(self, request_id: int) -> Request | None:
+        """Remove a queued request by id (None if not queued here)."""
+        for r in self._queue:
+            if r.request_id == request_id:
+                self._queue.remove(r)
+                return r
+        return None
+
+    def drain_queue(self) -> list[Request]:
+        """Remove and return everything queued (abort_all's shutdown path)."""
+        drained = list(self._queue)
+        self._queue.clear()
+        return drained
+
     @property
     def queue_depth(self) -> int:
         return len(self._queue)

@@ -15,6 +15,9 @@ RNG_STATE_NAME = "rng_state"
 CUSTOM_STATE_NAME = "custom_checkpoint"
 STEP_STATE_NAME = "step"
 CHECKPOINT_DIR_PREFIX = "checkpoint"
+# commit marker written only after every array/host write of a checkpoint
+# generation has landed on disk; its absence marks a crashed/in-flight save
+CHECKPOINT_COMPLETE_MARKER = "_COMPLETE"
 
 # Profile trace filename pattern (one per host), mirrors reference PROFILE_PATTERN_NAME
 PROFILE_PATTERN_NAME = "profile_{suffix}"

@@ -13,6 +13,40 @@ force_cpu_platform(8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "fault: deterministic fault-injection tests (reliability layer; "
+        "seeded, so stable under tier-1's -p no:randomly)",
+    )
+
+
+@pytest.fixture
+def fault_injection():
+    """Seeded fault-injection activator for `pytest.mark.fault` tests.
+
+    Yields a factory: ``activate(*specs, seed=...)`` builds a
+    `reliability.FaultInjector` over the given `FaultSpec`s and activates it
+    for the rest of the test (deactivated on teardown, nesting preserved).
+    The fixed default seed keeps every probabilistic spec deterministic under
+    tier-1's ``-p no:randomly``.
+    """
+    from accelerate_tpu.reliability import FaultInjector, faults
+
+    active = []
+
+    def activate(*specs, seed=1234):
+        injector = FaultInjector(seed=seed, specs=specs)
+        cm = faults.inject(injector)
+        cm.__enter__()
+        active.append(cm)
+        return injector
+
+    yield activate
+    while active:
+        active.pop().__exit__(None, None, None)
+
+
 @pytest.fixture(autouse=True)
 def reset_singletons():
     """Reset state singletons between tests (reference `AccelerateTestCase.tearDown`

@@ -8,9 +8,12 @@ the async path is TPU-first added value (multi-GB sharded saves must not
 stall the step loop).
 """
 
+from pathlib import Path
+
 import jax
 import numpy as np
 import optax
+import pytest
 
 from accelerate_tpu import checkpointing
 from accelerate_tpu.accelerator import Accelerator, ProjectConfiguration
@@ -94,6 +97,89 @@ def test_project_config_default_and_rotation_safety(tmp_path):
     model.params = jax.tree.map(lambda p: p * 0, model.params)
     acc.load_state(None)  # latest surviving checkpoint
     np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
+
+
+def test_async_save_commit_marker_lands_at_the_barrier(tmp_path):
+    """The _COMPLETE marker is the commit line: an async generation must not
+    carry it until every background writer has been joined error-free."""
+    from accelerate_tpu.utils.constants import CHECKPOINT_COMPLETE_MARKER
+
+    acc = _fresh_accelerator()
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+    _train_once(acc, model, opt, make_regression_batches(2, 8))
+    ckpt = acc.save_state(str(tmp_path / "ckpt"), async_save=True)
+    marker = Path(ckpt) / CHECKPOINT_COMPLETE_MARKER
+    assert not marker.exists()  # writers may still be in flight
+    acc.wait_for_checkpoint()
+    assert marker.exists()  # drained error-free -> committed
+    # sync saves commit inline
+    ckpt_sync = acc.save_state(str(tmp_path / "ckpt_sync"), async_save=False)
+    assert (Path(ckpt_sync) / CHECKPOINT_COMPLETE_MARKER).exists()
+
+
+def test_crash_recovery_scan_skips_every_torn_directory(tmp_path):
+    """latest_checkpoint_dir must skip each crash signature — a stale orbax
+    temp entry (even with a marker), and a host-pickles-only directory (no
+    _COMPLETE) — and fall back to the previous intact checkpoint."""
+    from accelerate_tpu.checkpointing import complete_checkpoint_dirs, latest_checkpoint_dir
+
+    acc = _fresh_accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        )
+    )
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+    _train_once(acc, model, opt, make_regression_batches(2, 8))
+    acc.save_state()  # checkpoint_0: intact
+    trained_a = np.asarray(model.params["a"]).copy()
+    # checkpoint_1: killed mid-async-write — a stale orbax temp dir remains
+    # (a marker next to it must NOT rescue it: the temp dir proves a torn write)
+    torn = tmp_path / "checkpoints" / "checkpoint_1"
+    (torn / "model_0.orbax-checkpoint-tmp-99").mkdir(parents=True)
+    (torn / "_COMPLETE").write_text("lies\n")
+    # checkpoint_2: killed between the host pickles and the array writes
+    pickles_only = tmp_path / "checkpoints" / "checkpoint_2"
+    pickles_only.mkdir(parents=True)
+    (pickles_only / "rng_state.pkl").write_bytes(b"partial")
+    (pickles_only / "step.pkl").write_bytes(b"partial")
+
+    assert latest_checkpoint_dir(acc).name == "checkpoint_0"
+    assert [d.name for d in complete_checkpoint_dirs(acc)] == ["checkpoint_0"]
+    model.params = jax.tree.map(lambda p: p * 0, model.params)
+    acc.load_state(None)
+    np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
+
+
+def test_truncated_array_file_falls_back_to_previous_checkpoint(tmp_path):
+    """Bit-rot the completeness scan cannot see: the latest checkpoint carries
+    its _COMPLETE marker but an array file is truncated. The restore fallback
+    chain must recover from the previous intact checkpoint instead of dying."""
+    acc = _fresh_accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        )
+    )
+    model, opt = acc.prepare((regression_apply_fn, regression_model_params()), optax.sgd(0.1))
+    _train_once(acc, model, opt, make_regression_batches(2, 8))
+    acc.save_state()  # checkpoint_0: intact
+    intact_a = np.asarray(model.params["a"]).copy()
+    _train_once(acc, model, opt, make_regression_batches(2, 8, seed=1))
+    acc.save_state()  # checkpoint_1: newer, about to rot
+    assert not np.allclose(np.asarray(model.params["a"]), intact_a)
+
+    corrupt = tmp_path / "checkpoints" / "checkpoint_1"
+    data_files = [
+        f for f in (corrupt / "model_0").rglob("*")
+        if f.is_file() and f.stat().st_size > 0
+    ]
+    assert data_files, "expected array files to corrupt"
+    for f in data_files:
+        f.write_bytes(f.read_bytes()[:3])  # truncate every array payload
+
+    model.params = jax.tree.map(lambda p: p * 0, model.params)
+    with pytest.warns(UserWarning, match="falling back"):
+        acc.load_state(None)  # checkpoint_1 fails to restore -> walks back
+    np.testing.assert_allclose(np.asarray(model.params["a"]), intact_a)
 
 
 def test_load_state_skips_uncommitted_checkpoint(tmp_path):

@@ -15,9 +15,14 @@ flax_nn = pytest.importorskip("flax.linen")
 
 from accelerate_tpu.models.generation import generate
 from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.reliability import FaultSpec
 from accelerate_tpu.serving import (
+    FINISH_ABORTED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
     REJECT_PROMPT_TOO_LONG,
     REJECT_QUEUE_FULL,
     FIFOScheduler,
@@ -313,6 +318,140 @@ def test_histogram_reservoir_stays_bounded():
     assert 0.0 <= h.quantile(0.5) <= 9999.0
     s = h.summary()
     assert s["count"] == 10_000 and s["p50"] <= s["p90"] <= s["p99"]
+
+
+# ---------------------------------------------------- watchdog / fault handling
+@pytest.mark.fault
+def test_watchdog_quarantines_only_the_poisoned_slot(model, fault_injection):
+    """A NaN-poisoned decode step degrades ONLY the affected slot: the healthy
+    request's tokens stay parity-identical to solo generate, and the poisoned
+    request is re-prefilled once from its prompt — ending parity-identical
+    too, because its rng chain restarts from the seed."""
+    module, params = model
+    prompts = _prompts(10, [4, 6])
+    n_new = 8
+    fault_injection(FaultSpec.poison(at_steps=(2,), slots=(1,)))
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    outs = engine.run([Request(p, SamplingParams(max_new_tokens=n_new))
+                       for p in prompts])
+    assert engine.metrics.steps_poisoned.value == 1
+    assert engine.metrics.requests_retried.value == 1
+    for out, prompt in zip(outs, prompts):
+        assert out.finish_reason == FINISH_LENGTH
+        assert out.tokens == _solo(module, params, prompt, n_new)
+
+
+@pytest.mark.fault
+def test_watchdog_second_poison_retires_with_error(model, fault_injection):
+    """One re-prefill is the retry budget: a request poisoned again after its
+    retry is retired with FINISH_ERROR (partial tokens kept) while the engine
+    stays serviceable."""
+    module, params = model
+    prompt = _prompts(11, [5])[0]
+    ref = _solo(module, params, prompt, 12)
+    # decode-step counter: step 1 poisons the first attempt (-> quarantine +
+    # re-prefill), step 4 poisons the retried attempt (-> FINISH_ERROR)
+    fault_injection(FaultSpec.poison(at_steps=(1, 4), slots=(0,)))
+    engine = ServingEngine(module, params, max_concurrency=1, prompt_buckets=(8,))
+    out = engine.run([Request(prompt, SamplingParams(max_new_tokens=12))])[0]
+    assert out.finish_reason == FINISH_ERROR
+    assert engine.metrics.requests_retried.value == 1
+    assert engine.metrics.steps_poisoned.value == 2
+    assert 0 < len(out.tokens) < 12
+    assert out.tokens == ref[:len(out.tokens)]  # valid prefix up to the poison
+    assert not engine.has_work and engine.active_slots == 0
+    # the engine keeps serving after retiring the errored request
+    out2 = engine.run([Request(prompt, SamplingParams(max_new_tokens=4))])[0]
+    assert out2.tokens == ref[:4]
+
+
+# ---------------------------------------------------- deadlines / cancel / drain
+def test_queued_request_past_deadline_is_rejected(model):
+    module, params = model
+    long_prompt, short_prompt = _prompts(12, [4, 4])
+    engine = ServingEngine(module, params, max_concurrency=1, prompt_buckets=(8,))
+    # slot taken by a long request; the deadline_s=0 request expires in queue
+    engine.submit(Request(long_prompt, SamplingParams(max_new_tokens=16)))
+    engine.submit(Request(short_prompt, SamplingParams(max_new_tokens=4),
+                          deadline_s=0.0))
+    outs = []
+    while engine.has_work:
+        outs.extend(engine.step())
+    reasons = {o.request_id: o.finish_reason for o in outs}
+    assert reasons[1] == f"rejected:{REJECT_DEADLINE}"
+    assert reasons[0] == FINISH_LENGTH  # the active request was untouched
+    assert engine.metrics.requests_expired.value == 1
+
+
+def test_cancel_queued_and_active_requests(model):
+    module, params = model
+    prompts = _prompts(13, [4, 4])
+    engine = ServingEngine(module, params, max_concurrency=1, prompt_buckets=(8,))
+    active_id = engine.submit(Request(prompts[0], SamplingParams(max_new_tokens=32))).request_id
+    queued_id = engine.submit(Request(prompts[1], SamplingParams(max_new_tokens=32))).request_id
+    engine.step()  # admits the first request; second stays queued
+    cancelled = engine.cancel(queued_id)
+    assert cancelled.finish_reason == FINISH_ABORTED and cancelled.tokens == []
+    assert engine.scheduler.queue_depth == 0
+    aborted = engine.cancel(active_id)
+    assert aborted.finish_reason == FINISH_ABORTED
+    assert len(aborted.tokens) > 0  # partial progress returned, not discarded
+    assert engine.cancel(999) is None
+    assert engine.metrics.requests_cancelled.value == 2
+    assert not engine.has_work and engine.active_slots == 0
+
+
+def test_drain_serves_backlog_and_rejects_new_submits(model):
+    module, params = model
+    prompts = _prompts(14, [4, 5, 6])
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    for p in prompts:
+        assert engine.submit(Request(p, SamplingParams(max_new_tokens=4))).accepted
+    outs = engine.drain()
+    assert sorted(o.request_id for o in outs) == [0, 1, 2]
+    assert all(o.finish_reason == FINISH_LENGTH for o in outs)
+    assert not engine.has_work
+    # while draining, new submits are shed with a reason (graceful shutdown)
+    engine._draining = True
+    rejected = engine.submit(Request(prompts[0], SamplingParams()))
+    assert not rejected.accepted and rejected.reason == REJECT_DRAINING
+    engine._draining = False
+
+
+def test_abort_all_returns_partial_outputs(model):
+    module, params = model
+    prompts = _prompts(15, [4, 4, 4])
+    engine = ServingEngine(module, params, max_concurrency=1, prompt_buckets=(8,))
+    for p in prompts:
+        engine.submit(Request(p, SamplingParams(max_new_tokens=32)))
+    engine.step()  # one active (with tokens), two queued
+    aborted = engine.abort_all()
+    assert sorted(o.request_id for o in aborted) == [0, 1, 2]
+    assert all(o.finish_reason == FINISH_ABORTED for o in aborted)
+    by_id = {o.request_id: o for o in aborted}
+    assert len(by_id[0].tokens) > 0  # active slot kept its partial stream
+    assert by_id[1].tokens == [] and by_id[2].tokens == []
+    assert not engine.has_work and engine.active_slots == 0
+
+
+def test_run_max_steps_aborts_leftovers_and_keeps_completed(model):
+    """run(max_steps=...) must return the completed outputs (not raise them
+    away) and abort whatever is still in flight with FINISH_ABORTED."""
+    module, params = model
+    prompts = _prompts(16, [4, 4])
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    outs = engine.run(
+        [Request(prompts[0], SamplingParams(max_new_tokens=2)),  # finishes fast
+         Request(prompts[1], SamplingParams(max_new_tokens=64))],  # cannot finish
+        max_steps=5,
+    )
+    assert len(outs) == 2
+    by_id = {o.request_id: o for o in outs}
+    assert by_id[0].finish_reason == FINISH_LENGTH
+    assert by_id[0].tokens == _solo(module, params, prompts[0], 2)
+    assert by_id[1].finish_reason == FINISH_ABORTED
+    assert 0 < len(by_id[1].tokens) < 64
+    assert not engine.has_work  # nothing leaks past the abort
 
 
 # ------------------------------------------------------------------- API guards

@@ -1,0 +1,145 @@
+"""Chaos replay: the bench_serving Poisson trace through `ServingEngine` with
+deterministic faults injected, asserting ZERO lost requests.
+
+"Lost" is the one unforgivable serving failure: a request that was accepted
+but never produced a terminal output. Under this harness every submitted
+request must end in exactly one of: finished (``eos``/``length``), watchdog
+error (``error``, after one re-prefill retry), deadline expiry
+(``rejected:deadline``), or a structural rejection — whatever faults fire.
+
+Faults injected (seeded via `reliability.FaultInjector`, so a failing run
+replays bit-identically):
+  - NaN-poisoned decode logits on slot 0 every ``CHAOS_POISON_EVERY`` steps
+    (exercising the watchdog quarantine/retry/FINISH_ERROR chain);
+  - a tight queue-wait deadline on every ``CHAOS_DEADLINE_EVERY``-th request
+    (exercising REJECT_DEADLINE queue expiry under load).
+
+Prints ONE JSON line: {"metric": "chaos_serve_lost_requests", "value": 0, ...}.
+
+Run: JAX_PLATFORMS=cpu python tools/chaos_serve.py
+Env knobs:
+  CHAOS_REQUESTS        trace length (default 24)
+  CHAOS_CONCURRENCY     engine slots (default 4)
+  CHAOS_RATE            Poisson arrival rate, req/s (default 500: saturating)
+  CHAOS_SEED            trace + injector rng seed (default 0)
+  CHAOS_POISON_EVERY    poison slot 0 every N decode steps (default 5; 0 = off)
+  CHAOS_DEADLINE_EVERY  every N-th request gets a deadline (default 6; 0 = off)
+  CHAOS_DEADLINE_S      that deadline, seconds of queue wait (default 0.0)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.bench_serving import BUCKETS, _trace  # noqa: E402
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def run(
+    n_requests: int = 24,
+    concurrency: int = 4,
+    rate: float = 500.0,
+    seed: int = 0,
+    poison_every: int = 5,
+    deadline_every: int = 6,
+    deadline_s: float = 0.0,
+    module=None,
+    params=None,
+) -> dict:
+    """Replay the trace under injected faults; assert zero lost requests and
+    return the summary dict (importable — tests/test_reliability.py runs it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.reliability import FaultInjector, FaultSpec, inject
+    from accelerate_tpu.serving import Request, ServingEngine
+
+    if module is None:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+    trace = _trace(n_requests, rate, seed, int(module.config.vocab_size))
+
+    specs = []
+    if poison_every:
+        specs.append(FaultSpec.poison(
+            at_steps=tuple(range(poison_every - 1, 100_000, poison_every)),
+            slots=(0,),
+        ))
+    injector = FaultInjector(seed=seed, specs=specs)
+    engine = ServingEngine(module, params, max_concurrency=concurrency,
+                           prompt_buckets=BUCKETS, max_queue=n_requests + 1)
+
+    submitted: dict[int, str] = {}
+    terminal: dict[int, str] = {}
+    t0 = time.perf_counter()
+    pending = list(trace)
+    i = 0
+    with inject(injector):
+        while pending or engine.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0].arrival_time <= now:
+                src = pending.pop(0)
+                tight = deadline_every and i % deadline_every == deadline_every - 1
+                result = engine.submit(Request(
+                    src.prompt, src.params,
+                    deadline_s=deadline_s if tight else None,
+                ))
+                submitted[result.request_id] = "deadline" if tight else "plain"
+                if not result.accepted:
+                    terminal[result.request_id] = f"rejected:{result.reason}"
+                i += 1
+            for out in engine.step():
+                terminal[out.request_id] = out.finish_reason
+            if not engine.has_work and pending:
+                time.sleep(max(0.0, pending[0].arrival_time - (time.perf_counter() - t0)))
+
+    lost = sorted(set(submitted) - set(terminal))
+    assert not lost, f"lost requests (accepted but no terminal output): {lost}"
+    reasons: dict[str, int] = {}
+    for reason in terminal.values():
+        reasons[reason] = reasons.get(reason, 0) + 1
+    m = engine.metrics
+    return {
+        "metric": "chaos_serve_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "poisson_rate": rate,
+            "seed": seed,
+            "terminal_reasons": reasons,
+            "steps": m.steps.value,
+            "steps_poisoned": m.steps_poisoned.value,
+            "requests_retried": m.requests_retried.value,
+            "requests_expired": m.requests_expired.value,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
+def main() -> None:
+    summary = run(
+        n_requests=_env_int("CHAOS_REQUESTS", 24),
+        concurrency=_env_int("CHAOS_CONCURRENCY", 4),
+        rate=float(os.environ.get("CHAOS_RATE", 500.0)),
+        seed=_env_int("CHAOS_SEED", 0),
+        poison_every=_env_int("CHAOS_POISON_EVERY", 5),
+        deadline_every=_env_int("CHAOS_DEADLINE_EVERY", 6),
+        deadline_s=float(os.environ.get("CHAOS_DEADLINE_S", 0.0)),
+    )
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
