@@ -96,7 +96,8 @@ class SelfAttention(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
+                 cache_write_mask: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.n_head
@@ -114,7 +115,7 @@ class SelfAttention(nn.Module):
             max_len = cfg.n_positions
             k_all, v_all, idx, is_init = decode_cache_update(
                 self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype,
-                per_slot=cfg.kv_cache_per_slot,
+                per_slot=cfg.kv_cache_per_slot, write_mask=cache_write_mask,
             )
             if is_init:
                 if cfg.kv_cache_per_slot:
@@ -165,11 +166,12 @@ class Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
+                 cache_write_mask: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         # pre-norm transformer; LN statistics in fp32
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_1")(x)
-        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode, cache_write_mask)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype), deterministic)
         return x
@@ -188,6 +190,7 @@ class GPT2LMHead(nn.Module):
         decode: bool = False,
         position_offset: jax.Array | int = 0,
         return_hidden: bool = False,
+        cache_write_mask: jax.Array | None = None,
     ) -> jax.Array:
         cfg = self.config
         b, s = input_ids.shape
@@ -215,7 +218,7 @@ class GPT2LMHead(nn.Module):
             block = remat_block(Block, cfg.remat_policy, static_argnums=(2, 3))
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic, decode), None),
+                lambda mdl, carry, _: (mdl(carry, deterministic, decode, cache_write_mask), None),
                 # fp8_meta (per-layer delayed-scaling state) stacks on the same
                 # leading layer axis as the params
                 variable_axes={"params": 0, "fp8_meta": 0},
@@ -225,7 +228,7 @@ class GPT2LMHead(nn.Module):
             )(block(cfg, name="blocks"), x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"block_{i}")(x, deterministic, decode)
+                x = block(cfg, name=f"block_{i}")(x, deterministic, decode, cache_write_mask)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
         if return_hidden:

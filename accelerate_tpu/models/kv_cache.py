@@ -27,6 +27,7 @@ def decode_cache_update(
     max_len: int,
     kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized
     per_slot: bool = False,  # [b]-vector write index (continuous batching)
+    write_mask: jax.Array | None = None,  # [b] bool: False rows freeze (per_slot)
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Create/update the module's decode cache and return
     ``(k_all, v_all, write_index, is_init)``.
@@ -42,12 +43,24 @@ def decode_cache_update(
     a different position in an independent sequence — `serving/engine.py`).
     ``write_index`` is then the ``[b]`` vector and row starts clamp into range
     exactly like ``dynamic_update_slice``.
+
+    ``write_mask`` (per_slot only) freezes rows where it is False: the row's
+    buffers and write index are left bit-identical instead of being written.
+    This is the serving engine's on-device finished mask — with pipelined
+    dispatch the host's retirement lags the device by up to ``pipeline_depth``
+    steps, and a finished slot must not keep mutating its cache while it waits
+    to be recycled.
     """
     if kv_cache_dtype is not None and np.dtype(kv_cache_dtype) != np.dtype("int8"):
         # fail fast with the cause named — an arbitrary dtype would surface as
         # an obscure lax dtype-mismatch deep in the cache update
         raise ValueError(
             f"kv_cache_dtype supports None (compute dtype) or int8, got {kv_cache_dtype}"
+        )
+    if write_mask is not None and not per_slot:
+        raise ValueError(
+            "write_mask requires per_slot=True (the scalar-index cache has no "
+            "per-row freeze semantics)"
         )
     quant = kv_cache_dtype is not None
     b, s, kv_heads, head_dim = k.shape
@@ -81,11 +94,32 @@ def decode_cache_update(
         return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
     idx = cache_idx.value
+    next_idx = idx + s
     if per_slot:
         # row-wise scatter: each batch row writes at its own index (vmapped
         # dynamic_update_slice keeps the update static-shape and fully jittable)
-        row4 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0, 0)))
-        row3 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0)))
+        if write_mask is None:
+            row4 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0, 0)))
+            row3 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0)))
+            next_idx = idx + s
+        else:
+            # frozen rows (mask False) re-write their CURRENT entries — a
+            # bit-exact no-op — and keep their index, so a finished slot's
+            # cache never moves while host retirement lags the device
+            def _masked_row(lead_zeros):
+                def upd(buf, new, i, m):
+                    start = (i,) + (0,) * lead_zeros
+                    cur = jax.lax.dynamic_slice(buf, start, new.shape)
+                    return jax.lax.dynamic_update_slice(
+                        buf, jnp.where(m, new, cur), start
+                    )
+
+                return jax.vmap(upd, in_axes=(0, 0, 0, 0))
+
+            _row4, _row3 = _masked_row(2), _masked_row(1)
+            row4 = lambda buf, new, i: _row4(buf, new, i, write_mask)  # noqa: E731
+            row3 = lambda buf, new, i: _row3(buf, new, i, write_mask)  # noqa: E731
+            next_idx = idx + s * write_mask.astype(idx.dtype)
         if quant:
             kq, ks = _q(k)
             vq, vs = _q(v)
@@ -112,5 +146,29 @@ def decode_cache_update(
         k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
         v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
         cached_k.value, cached_v.value = k_all, v_all
-    cache_idx.value = idx + s
+    cache_idx.value = next_idx
     return k_all, v_all, idx, True
+
+
+def scatter_cache_slots(
+    pool_cache: Any,  # the [B, ...] slot-pool cache pytree
+    new_cache: Any,  # an [nb, ...] freshly prefilled cache pytree
+    slots: jax.Array,  # [nb] int32 distinct pool rows to write
+    cache_index: jax.Array,  # [nb] int32 per-row resume index (unpadded length)
+) -> Any:
+    """Scatter an ``nb``-row prefill cache into pool rows ``slots`` in ONE
+    jitted op per leaf (the serving engine's batched admission: `pipeline
+    decode dispatch`, `serving/engine.py`).
+
+    Every leaf's rows land at ``pool_leaf[slots[i]]``. The ``cache_index``
+    leaf is OVERWRITTEN with ``cache_index`` — the prefill advanced it to the
+    padded bucket length, but decode must resume (and overwrite the pad
+    entries) from each row's true prompt end.
+    """
+
+    def insert(path, pool_leaf, new_leaf):
+        if getattr(path[-1], "key", None) == "cache_index":
+            return pool_leaf.at[slots].set(cache_index.astype(pool_leaf.dtype))
+        return pool_leaf.at[slots].set(new_leaf.astype(pool_leaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(insert, pool_cache, new_cache)

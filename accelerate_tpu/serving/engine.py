@@ -1,4 +1,5 @@
-"""Continuous-batching serving engine: one hot decode step, many requests.
+"""Continuous-batching serving engine: one hot decode step, many requests,
+pipelined host/device dispatch.
 
 `models/generation.generate` runs a batch in lockstep — equal-length prompts,
 every row decodes until the slowest finishes, nobody joins mid-flight. This
@@ -9,25 +10,38 @@ decode step instead (the serving half of the ROADMAP north star):
     ...]`` fixed buffers in the `models/kv_cache.py` layout with the per-slot
     ``[b]`` write-index variant (int8 storage supported via the model config's
     ``kv_cache_dtype``);
-  - admission prefills one request at a bucketed prompt length into a fresh
-    single-slot cache and scatters it into the pool at the free slot — one
-    compile per bucket, never per prompt length — and samples the first token
-    in the same jitted call (TTFT = queue wait + one prefill);
+  - admission prefills up to ``admit_batch`` queued requests of one prompt
+    bucket in a SINGLE jitted call (one compile per ``(prompt_bucket,
+    batch_bucket)`` pair), samples their first tokens, and scatters all the
+    new slots into the pool at once (`kv_cache.scatter_cache_slots`);
   - ``step()`` decodes ALL slots in one jitted call with donated cache
-    buffers; per-slot positions, sampling params, and rng keys ride as
-    ``[max_concurrency]`` data arrays, so requests joining or retiring never
-    retrace;
-  - a slot is recycled the moment its request hits EOS, its token budget, or
-    the context limit; the FIFO scheduler backfills it on the next step.
+    buffers; per-slot positions, sampling params, rng keys, remaining budget,
+    and the finished mask are DEVICE-RESIDENT ``[max_concurrency]`` arrays,
+    written only by the jitted admission scatter — the decode hot loop uploads
+    nothing per token.
+
+The decode loop is **self-feeding and pipelined**: step N+1 dispatches
+immediately from step N's on-device sampled tokens while the host fetch of
+step N's results completes asynchronously, up to ``pipeline_depth`` dispatches
+in flight (depth 1 reproduces fully synchronous dispatch bit-for-bit). An
+on-device finished mask — EOS hit, token budget, context limit, or watchdog
+health — freezes a slot inside the compiled step (token/position/cache writes
+all stop, `kv_cache.decode_cache_update(write_mask=...)`), so host-side
+retirement/backfill lagging by up to ``pipeline_depth`` steps can never
+corrupt a stream: the host simply truncates the lagged tail at the finish
+point, token-identical to a solo ``generate``. A per-slot generation counter
+discards fetched results that postdate a retirement/cancel/quarantine.
 
 Static-shape invariant (the whole point): the decode step's shapes depend only
 on ``(max_concurrency, n_positions, model config)`` and admission's only on
-the prompt bucket. Everything request-specific is data, not shape.
+``(prompt_bucket, batch_bucket)``. Everything request-specific is data, not
+shape.
 
 Sampling parity: the per-slot sampler value-matches `generation._sample` and
 the per-slot rng chain matches `generate`'s split sequence for a batch-1 call,
 so a request served here emits the SAME tokens as a solo ``generate`` with
-``rng=jax.random.key(seed)`` (tests/test_serving.py proves it token-level).
+``rng=jax.random.key(seed)`` — at every ``pipeline_depth`` and ``admit_batch``
+(tests/test_serving.py proves it token-level).
 """
 
 from __future__ import annotations
@@ -41,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models.kv_cache import scatter_cache_slots
 from ..reliability.faults import ALL_SLOTS, active_injector
 from .metrics import ServingMetrics
 from .request import (
@@ -79,6 +94,23 @@ def _sample_slot(logits: jax.Array, key: jax.Array, temperature: jax.Array,
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched-but-unfetched device computation.
+
+    ``arrays`` are the device outputs the host will need (tokens + finished
+    mask, plus the health flag for decode steps); ``slots``/``gens`` pin each
+    result to the slot GENERATION it was dispatched against, so a result that
+    postdates a retirement, cancel, or quarantine is discarded instead of
+    being attributed to the slot's next tenant.
+    """
+
+    kind: str  # "step" | "admit"
+    arrays: tuple
+    slots: tuple[int, ...]
+    gens: tuple[int, ...]
+
+
 class ServingEngine:
     """Request-level continuous batching over a fixed pool of decode slots.
 
@@ -86,6 +118,12 @@ class ServingEngine:
     (GPT-2 today); the engine re-instantiates it with the flag on, so callers
     pass the same module they would hand to ``generate``. ``params`` is the
     matching param tree. The context length is the config's ``n_positions``.
+
+    ``pipeline_depth`` bounds how many decode dispatches may be in flight
+    before the host blocks on the oldest fetch (1 = fully synchronous, the
+    pre-pipelining behavior, bit-for-bit). ``admit_batch`` caps how many
+    same-bucket queued requests one jitted prefill admits (batch buckets are
+    the powers of two up to it, so compiles stay bounded).
 
     Typical loop::
 
@@ -107,6 +145,8 @@ class ServingEngine:
         prompt_buckets: tuple[int, ...] = (32, 128, 512),
         max_queue: int = 128,
         eos_token_id: int | None = None,
+        pipeline_depth: int = 2,
+        admit_batch: int = 4,
         tracker: Any = None,
         metrics_log_every: int = 0,
         metrics: ServingMetrics | None = None,
@@ -126,6 +166,17 @@ class ServingEngine:
         self.max_concurrency = int(max_concurrency)
         if self.max_concurrency < 1:
             raise ValueError(f"max_concurrency must be >= 1, got {max_concurrency}")
+        self.pipeline_depth = int(pipeline_depth)
+        if self.pipeline_depth < 1:
+            raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if int(admit_batch) < 1:
+            raise ValueError(f"admit_batch must be >= 1, got {admit_batch}")
+        # batch buckets: powers of two up to admit_batch — each size is one
+        # more admission compile per prompt bucket, so keep the set small
+        self._admit_sizes = tuple(
+            1 << i for i in range(int(admit_batch).bit_length())
+            if 1 << i <= int(admit_batch)
+        )
         buckets = tuple(sorted({int(b) for b in prompt_buckets if int(b) <= self.max_len}))
         if not buckets:
             raise ValueError(
@@ -143,30 +194,39 @@ class ServingEngine:
         self.metrics_log_every = int(metrics_log_every)
 
         b = self.max_concurrency
-        # device state: the slot-pool cache (donated through every step) and
-        # the per-slot rng chain, kept as raw key data so slot updates are
-        # plain .at[].set ops
+        # device state: the slot-pool cache (donated through every step) plus
+        # ALL per-slot decode state — last token, position, sampling params,
+        # rng chain (raw key data so slot updates are plain scatters), token
+        # budget, and the finished mask. The decode loop never uploads any of
+        # it; only the jitted admission scatter writes slots.
         self._cache = self.module.init(
             jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True
         )["cache"]
         kd = jax.random.key_data(jax.random.key(0))
         self._rng_data = jnp.zeros((b,) + kd.shape, kd.dtype)
+        self._d_tokens = jnp.zeros((b,), jnp.int32)
+        self._d_pos = jnp.zeros((b,), jnp.int32)
+        self._d_temps = jnp.zeros((b,), jnp.float32)
+        self._d_topks = jnp.zeros((b,), jnp.int32)
+        self._d_remaining = jnp.zeros((b,), jnp.int32)
+        self._d_finished = jnp.ones((b,), bool)  # empty slots stay frozen
+        self._d_eos = jnp.int32(-1 if eos_token_id is None else int(eos_token_id))
+        self._no_poison = jnp.zeros((b,), bool)  # reused when no injector is active
         self._fresh_shapes = jax.eval_shape(
             lambda: self.module.init(
                 jax.random.key(0), jnp.zeros((1, 1), jnp.int32), decode=True
             )["cache"]
         )
-        # host-side slot state, passed into the step as [b] data arrays
-        self._tokens = np.zeros(b, np.int32)
-        self._pos = np.zeros(b, np.int32)
-        self._temps = np.zeros(b, np.float32)
-        self._topks = np.zeros(b, np.int32)
+        # host-side slot bookkeeping: which request/output each slot serves,
+        # and a per-slot generation counter that invalidates in-flight results
+        # dispatched against a previous tenant
         self._active = np.zeros(b, bool)
-        self._budget = np.zeros(b, np.int64)
+        self._slot_gen = np.zeros(b, np.int64)
         self._slot_req: list[Request | None] = [None] * b
         self._slot_out: list[RequestOutput | None] = [None] * b
         self._slot_last_token_t = [0.0] * b
         self._free: deque[int] = deque(range(b))
+        self._inflight: deque[_Inflight] = deque()
         self._next_id = 0
         self._step_count = 0
         self._vocab = int(getattr(module.config, "vocab_size", 0) or 0)
@@ -178,10 +238,16 @@ class ServingEngine:
     def _build_step_fn(self):
         module = self.module
 
-        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data, poison):
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data,
+                    finished, remaining, poison, eos_id):
+            live = ~finished
+            # finished slots are frozen INSIDE the compiled step: their cache
+            # rows are not written (write_mask), and below their token/pos/
+            # budget are carried unchanged — so however far host retirement
+            # lags, a finished slot's state is bit-stable until re-admission
             logits, mutated = module.apply(
                 {"params": params, "cache": cache}, tokens[:, None], decode=True,
-                position_offset=pos, mutable=["cache"],
+                position_offset=pos, mutable=["cache"], cache_write_mask=live,
             )
             last = logits[:, -1]
             # fault injection rides INSIDE the compiled step (poison is a [b]
@@ -195,45 +261,67 @@ class ServingEngine:
             rngs = jax.random.wrap_key_data(rng_data)
             split = jax.vmap(jax.random.split)(rngs)  # [b, 2] keys
             new_rngs, keys = split[:, 0], split[:, 1]
-            nxt = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
-            return mutated["cache"], nxt, jax.random.key_data(new_rngs), ok
+            sampled = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            healthy = live & ok
+            nxt = jnp.where(healthy, sampled, tokens)
+            new_pos = jnp.where(healthy, pos + 1, pos)
+            new_remaining = jnp.where(healthy, remaining - 1, remaining)
+            hit_eos = (eos_id >= 0) & (nxt == eos_id)
+            # the on-device finish sources: EOS, token budget (which already
+            # encodes the context limit), and watchdog health — a poisoned
+            # slot freezes immediately so it stops mutating its cache while
+            # the host decides to quarantine it
+            new_finished = finished | (live & (~ok | hit_eos | (new_remaining <= 0)))
+            return (mutated["cache"], nxt, new_pos, new_remaining, new_finished,
+                    jax.random.key_data(new_rngs), ok | finished)
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
     def _build_admit_fn(self):
         module, fresh_shapes = self.module, self._fresh_shapes
 
-        def admit_fn(pool_cache, params, prompt_row, slot, prompt_len, temp, top_k, rng):
-            # prefill the whole (right-padded) bucket into a fresh single-slot
-            # cache; the causal mask keeps pad positions from reaching the last
-            # real token's logits, and the write index reset below keeps decode
-            # from ever attending the stale pad entries
-            fresh = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), fresh_shapes)
+        def admit_fn(pool_cache, params, prompt_rows, slots, prompt_lens, temps,
+                     top_ks, rng_batch, budgets, d_tokens, d_pos, d_temps,
+                     d_topks, d_finished, d_remaining, rng_data, eos_id):
+            # prefill ALL nb (right-padded) rows of one prompt bucket in one
+            # pass into a fresh nb-slot cache; the causal mask keeps pad
+            # positions from reaching each row's last real token's logits, and
+            # the cache_index reset in the scatter keeps decode from ever
+            # attending the stale pad entries
+            nb = prompt_rows.shape[0]
+            fresh = jax.tree.map(
+                lambda s: jnp.zeros((nb,) + s.shape[1:], s.dtype), fresh_shapes
+            )
             logits, mutated = module.apply(
-                {"params": params, "cache": fresh}, prompt_row[None, :], decode=True,
+                {"params": params, "cache": fresh}, prompt_rows, decode=True,
                 position_offset=0, mutable=["cache"],
             )
-            last = jax.lax.dynamic_slice(
-                logits[0], (prompt_len - 1, 0), (1, logits.shape[-1])
-            )[0]
-            rng, key = jax.random.split(rng)
-            token = _sample_slot(last, key, temp, top_k)
-
-            def insert(path, pool_leaf, new_leaf):
-                if getattr(path[-1], "key", None) == "cache_index":
-                    # the prefill wrote the full bucket; the slot's true length
-                    # is the unpadded prompt — decode resumes (and overwrites
-                    # the pad entries) from there
-                    new_leaf = jnp.full_like(new_leaf, prompt_len)
-                start = (slot,) + (0,) * (pool_leaf.ndim - 1)
-                return jax.lax.dynamic_update_slice(
-                    pool_leaf, new_leaf.astype(pool_leaf.dtype), start
-                )
-
-            new_pool = jax.tree_util.tree_map_with_path(
-                insert, pool_cache, mutated["cache"]
+            last = jax.vmap(
+                lambda row, n: jax.lax.dynamic_slice(
+                    row, (n - 1, 0), (1, row.shape[-1])
+                )[0]
+            )(logits, prompt_lens)
+            rngs = jax.random.wrap_key_data(rng_batch)
+            split = jax.vmap(jax.random.split)(rngs)  # [nb, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            first = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            new_pool = scatter_cache_slots(
+                pool_cache, mutated["cache"], slots, prompt_lens
             )
-            return new_pool, token, jax.random.key_data(rng)
+            # first token rides out of the prefill itself; budget-1 tokens
+            # remain for the decode loop (a 1-token budget or first-token EOS
+            # is finished on arrival)
+            rem0 = budgets - 1
+            fin0 = (rem0 <= 0) | ((eos_id >= 0) & (first == eos_id))
+            d_tokens = d_tokens.at[slots].set(first)
+            d_pos = d_pos.at[slots].set(prompt_lens)
+            d_temps = d_temps.at[slots].set(temps)
+            d_topks = d_topks.at[slots].set(top_ks)
+            d_finished = d_finished.at[slots].set(fin0)
+            d_remaining = d_remaining.at[slots].set(rem0)
+            rng_data = rng_data.at[slots].set(jax.random.key_data(new_rngs))
+            return (new_pool, first, fin0, d_tokens, d_pos, d_temps, d_topks,
+                    d_finished, d_remaining, rng_data)
 
         return jax.jit(admit_fn, donate_argnums=(0,))
 
@@ -273,36 +361,39 @@ class ServingEngine:
 
     # ------------------------------------------------------------ engine loop
     def step(self) -> list[RequestOutput]:
-        """Admit into free slots, decode one token for every active slot, and
-        return the requests that finished during this step."""
+        """Admit into free slots, dispatch one decode step for every active
+        slot, fetch results lagging by up to ``pipeline_depth`` dispatches,
+        and return the requests whose completion was OBSERVED during this
+        call (at depth > 1 a finish surfaces when its fetch lands, up to
+        ``pipeline_depth - 1`` calls after the device produced it)."""
         finished: list[RequestOutput] = []
+        self._reap_ready(finished)
         self._admit_pending(finished)
         n_active = self.active_slots
         self.metrics.observe_step(n_active, self.max_concurrency,
                                   self.scheduler.queue_depth)
         self._step_count += 1
         if n_active:
-            cache, nxt, rng_data, ok = self._step_fn(
-                self._cache, self.params, jnp.asarray(self._tokens),
-                jnp.asarray(self._pos), jnp.asarray(self._temps),
-                jnp.asarray(self._topks), self._rng_data,
-                jnp.asarray(self._poison_mask()),
+            poison = self._poison_mask()
+            (self._cache, nxt, self._d_pos, self._d_remaining, fin,
+             self._rng_data, ok) = self._step_fn(
+                self._cache, self.params, self._d_tokens, self._d_pos,
+                self._d_temps, self._d_topks, self._rng_data, self._d_finished,
+                self._d_remaining,
+                self._no_poison if poison is None else jnp.asarray(poison),
+                self._d_eos,
             )
-            self._cache, self._rng_data = cache, rng_data
-            tokens = np.asarray(jax.device_get(nxt))
-            healthy = np.asarray(jax.device_get(ok))
-            now = time.perf_counter()
-            poisoned_any = False
-            for slot in np.flatnonzero(self._active):
-                slot = int(slot)
-                token = int(tokens[slot])
-                if not healthy[slot] or (self._vocab and not 0 <= token < self._vocab):
-                    poisoned_any = True
-                    self._quarantine(slot, now, finished)
-                else:
-                    self._emit_token(slot, token, now, finished)
-            if poisoned_any:
-                self.metrics.steps_poisoned.inc()
+            self._d_tokens, self._d_finished = nxt, fin
+            self.metrics.dispatch_depth.observe(len(self._inflight) + 1)
+            self._inflight.append(_Inflight(
+                "step", (nxt, fin, ok),
+                tuple(range(self.max_concurrency)), tuple(self._slot_gen),
+            ))
+            self._drain_to(self.pipeline_depth - 1, finished)
+        if not self._active.any():
+            # nothing left to overlap with — flush the lagged tail so every
+            # observed finish is returned before the caller sees has_work False
+            self._drain_to(0, finished)
         if (self.tracker is not None and self.metrics_log_every
                 and self._step_count % self.metrics_log_every == 0):
             self.metrics.log_to(self.tracker, step=self._step_count)
@@ -357,8 +448,9 @@ class ServingEngine:
     # --------------------------------------------------- lifecycle / shutdown
     def cancel(self, request_id: int) -> RequestOutput | None:
         """Abort one request wherever it is — queued (removed) or mid-decode
-        (slot retired with `FINISH_ABORTED`, partial tokens returned). None if
-        the id is unknown or already finished."""
+        (slot retired with `FINISH_ABORTED`, partial tokens returned; any
+        in-flight device results for it are discarded by the slot's
+        generation bump). None if the id is unknown or already finished."""
         now = time.perf_counter()
         queued = self.scheduler.cancel(request_id)
         if queued is not None:
@@ -396,7 +488,8 @@ class ServingEngine:
 
     def abort_all(self) -> list[RequestOutput]:
         """Hard shutdown: abort every queued and active request with
-        `FINISH_ABORTED` (partial tokens kept for active ones)."""
+        `FINISH_ABORTED` (partial tokens kept for active ones). In-flight
+        device results are discarded unfetched."""
         now = time.perf_counter()
         aborted: list[RequestOutput] = []
         for req in self.scheduler.drain_queue():
@@ -409,35 +502,115 @@ class ServingEngine:
         for slot in np.flatnonzero(self._active):
             self.metrics.requests_cancelled.inc()
             self._retire(int(slot), FINISH_ABORTED, now, aborted)
+        self._inflight.clear()  # every entry now predates a generation bump
         return aborted
 
     # -------------------------------------------------------------- internals
-    def _poison_mask(self) -> np.ndarray:
-        """The [b] NaN-poison mask for this step — all-False in production;
-        an active `reliability.FaultInjector` can mark slots for poisoning
-        (its decode-step counter ticks once per step() with active slots)."""
-        mask = np.zeros(self.max_concurrency, bool)
+    def _poison_mask(self) -> np.ndarray | None:
+        """The [b] NaN-poison mask for this step — None in production (the
+        cached all-False device array is reused, no upload); an active
+        `reliability.FaultInjector` can mark slots for poisoning (its
+        decode-step counter ticks once per dispatched decode step)."""
         injector = active_injector()
-        if injector is not None:
-            slots = injector.poison_slots()
-            if slots is not None:
-                if slots == ALL_SLOTS:
-                    mask[self._active] = True
-                else:
-                    for s in slots:
-                        if 0 <= s < self.max_concurrency and self._active[s]:
-                            mask[s] = True
+        if injector is None:
+            return None
+        mask = np.zeros(self.max_concurrency, bool)
+        slots = injector.poison_slots()
+        if slots is not None:
+            if slots == ALL_SLOTS:
+                mask[self._active] = True
+            else:
+                for s in slots:
+                    if 0 <= s < self.max_concurrency and self._active[s]:
+                        mask[s] = True
         return mask
+
+    def _reap_ready(self, finished: list[RequestOutput]) -> None:
+        """Process in-flight results the device has ALREADY finished, without
+        blocking. Pipelining tolerates retirement lag, it doesn't require it:
+        a finished slot whose result sits fetchable costs a frozen (wasted)
+        decode step per step it waits, so reaping eagerly keeps occupancy at
+        the synchronous level — lag then only happens when the device is
+        genuinely still busy, which is exactly when overlap pays."""
+        while self._inflight:
+            head = self._inflight[0].arrays[0]
+            is_ready = getattr(head, "is_ready", None)
+            if is_ready is None or not is_ready():
+                return
+            self._process_oldest(finished)
+
+    def _drain_to(self, limit: int, finished: list[RequestOutput]) -> None:
+        """Block-fetch the oldest in-flight results until at most ``limit``
+        dispatches remain in flight (limit 0 = fully synchronous)."""
+        while len(self._inflight) > limit:
+            self._process_oldest(finished)
+
+    def _process_oldest(self, finished: list[RequestOutput]) -> None:
+        entry = self._inflight.popleft()
+        blocked_t = time.perf_counter()
+        fetched = jax.device_get(entry.arrays)
+        self.metrics.host_blocked_s.observe(time.perf_counter() - blocked_t)
+        now = time.perf_counter()
+        if entry.kind == "admit":
+            self._process_admit(entry, fetched, now, finished)
+        else:
+            self._process_step(entry, fetched, now, finished)
+
+    def _process_admit(self, entry: _Inflight, fetched: tuple, now: float,
+                       finished: list[RequestOutput]) -> None:
+        tokens, fins = (np.asarray(a) for a in fetched)
+        for i, (slot, gen) in enumerate(zip(entry.slots, entry.gens)):
+            if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
+                continue  # cancelled/aborted while the prefill was in flight
+            out = self._slot_out[slot]
+            request = self._slot_req[slot]
+            out.first_token_time = now
+            if request.arrival_time is not None:
+                self.metrics.ttft_s.observe(max(0.0, now - request.arrival_time))
+            token = int(tokens[i])
+            out.tokens.append(token)
+            self.metrics.tokens_generated.inc()
+            self._slot_last_token_t[slot] = now
+            if fins[i]:
+                reason = (FINISH_EOS if self.eos_token_id is not None
+                          and token == self.eos_token_id else FINISH_LENGTH)
+                self._retire(slot, reason, now, finished)
+
+    def _process_step(self, entry: _Inflight, fetched: tuple, now: float,
+                      finished: list[RequestOutput]) -> None:
+        tokens, fins, healthy = (np.asarray(a) for a in fetched)
+        poisoned_any = False
+        for slot, gen in zip(entry.slots, entry.gens):
+            if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
+                continue  # retired/cancelled/requeued while this was in flight
+            token = int(tokens[slot])
+            if not healthy[slot] or (self._vocab and not 0 <= token < self._vocab):
+                poisoned_any = True
+                self._quarantine(slot, now, finished)
+                continue
+            out = self._slot_out[slot]
+            out.tokens.append(token)
+            self.metrics.tokens_generated.inc()
+            self.metrics.inter_token_s.observe(now - self._slot_last_token_t[slot])
+            self._slot_last_token_t[slot] = now
+            if fins[slot]:
+                reason = (FINISH_EOS if self.eos_token_id is not None
+                          and token == self.eos_token_id else FINISH_LENGTH)
+                self._retire(slot, reason, now, finished)
+        if poisoned_any:
+            self.metrics.steps_poisoned.inc()
 
     def _quarantine(self, slot: int, now: float,
                     finished: list[RequestOutput]) -> None:
         """Watchdog action for a poisoned slot (non-finite logits or an
         out-of-range sampled token): the slot's stream is garbage from this
         step on, but every other slot is untouched — so quarantine ONLY this
-        one. First offence: free the slot and re-prefill the request from its
-        prompt (front of queue; its rng chain restarts from the seed, so the
-        replay is token-identical to an unpoisoned run). Second offence:
-        retire with `FINISH_ERROR`, keeping the engine serving healthy slots."""
+        one. The device already froze the slot (health is a finish source in
+        the compiled step), so no lagged dispatch mutates it further. First
+        offence: free the slot and re-prefill the request from its prompt
+        (front of queue; its rng chain restarts from the seed, so the replay
+        is token-identical to an unpoisoned run). Second offence: retire with
+        `FINISH_ERROR`, keeping the engine serving healthy slots."""
         request = self._slot_req[slot]
         if request.retries == 0:
             request.retries += 1
@@ -459,59 +632,62 @@ class ServingEngine:
                 arrival_time=request.arrival_time, finish_time=now,
             ))
         while self._free:
-            request = self.scheduler.next_ready()
-            if request is None:
+            run_len = self.scheduler.peek_run(
+                min(len(self._free), self._admit_sizes[-1])
+            )
+            if run_len == 0:
                 return
-            slot = self._free.popleft()
-            prompt_len = len(request.prompt)
-            bucket = self.scheduler.bucket_for(prompt_len)
-            padded = np.zeros(bucket, np.int32)
-            padded[:prompt_len] = request.prompt
-            sp = request.params
-            cache, token, rng_data = self._admit_fn(
+            nb = max(s for s in self._admit_sizes if s <= run_len)
+            group = self.scheduler.pop_run(nb)
+            slots = [self._free.popleft() for _ in group]
+            bucket = self.scheduler.bucket_for(max(len(r.prompt) for r in group))
+            padded = np.zeros((nb, bucket), np.int32)
+            lens = np.zeros(nb, np.int32)
+            temps = np.zeros(nb, np.float32)
+            topks = np.zeros(nb, np.int32)
+            budgets = np.zeros(nb, np.int32)
+            rng_rows = []
+            for i, request in enumerate(group):
+                plen = len(request.prompt)
+                padded[i, :plen] = request.prompt
+                lens[i] = plen
+                sp = request.params
+                temps[i] = sp.temperature
+                topks[i] = sp.top_k or 0
+                # the context is fixed-size: cap generation so cache writes
+                # stay inside [0, n_positions)
+                budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen)
+                rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
+            (self._cache, first, fin0, self._d_tokens, self._d_pos,
+             self._d_temps, self._d_topks, self._d_finished,
+             self._d_remaining, self._rng_data) = self._admit_fn(
                 self._cache, self.params, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(prompt_len),
-                jnp.float32(sp.temperature), jnp.int32(sp.top_k or 0),
-                jax.random.key(sp.seed),
+                jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(topks),
+                jnp.stack(rng_rows), jnp.asarray(budgets),
+                self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
+                self._d_finished, self._d_remaining, self._rng_data,
+                self._d_eos,
             )
-            self._cache = cache
-            self._rng_data = self._rng_data.at[slot].set(rng_data)
-            first = int(jax.device_get(token))
-            now = time.perf_counter()
-            out = RequestOutput(
-                request_id=request.request_id, prompt_len=prompt_len, tokens=[],
-                finish_reason="", arrival_time=request.arrival_time,
-                first_token_time=now,
-            )
-            self._slot_req[slot] = request
-            self._slot_out[slot] = out
-            self._tokens[slot] = first
-            self._pos[slot] = prompt_len
-            self._temps[slot] = sp.temperature
-            self._topks[slot] = sp.top_k or 0
-            # the context is fixed-size: cap generation so cache writes stay
-            # inside [0, n_positions)
-            self._budget[slot] = min(int(sp.max_new_tokens), self.max_len - prompt_len)
-            self._active[slot] = True
-            self.metrics.prefill_tokens.inc(prompt_len)
-            if request.arrival_time is not None:
-                self.metrics.ttft_s.observe(max(0.0, now - request.arrival_time))
-            self._emit_token(slot, first, now, finished, from_admit=True)
-
-    def _emit_token(self, slot: int, token: int, now: float,
-                    finished: list[RequestOutput], from_admit: bool = False) -> None:
-        out = self._slot_out[slot]
-        out.tokens.append(token)
-        self.metrics.tokens_generated.inc()
-        if not from_admit:
-            self._pos[slot] += 1
-            self._tokens[slot] = token
-            self.metrics.inter_token_s.observe(now - self._slot_last_token_t[slot])
-        self._slot_last_token_t[slot] = now
-        if self.eos_token_id is not None and token == self.eos_token_id:
-            self._retire(slot, FINISH_EOS, now, finished)
-        elif len(out.tokens) >= self._budget[slot]:
-            self._retire(slot, FINISH_LENGTH, now, finished)
+            gens = []
+            for slot, request in zip(slots, group):
+                self._slot_gen[slot] += 1
+                gens.append(int(self._slot_gen[slot]))
+                self._slot_req[slot] = request
+                self._slot_out[slot] = RequestOutput(
+                    request_id=request.request_id, prompt_len=len(request.prompt),
+                    tokens=[], finish_reason="", arrival_time=request.arrival_time,
+                )
+                self._active[slot] = True
+            self.metrics.prefill_tokens.inc(int(lens.sum()))
+            self.metrics.admit_batch_size.observe(nb)
+            self._inflight.append(_Inflight(
+                "admit", (first, fin0), tuple(slots), tuple(gens)
+            ))
+            # at depth 1 this fetches the first tokens NOW — an EOS or 1-token
+            # budget frees its slot before the next group is sized, exactly
+            # the pre-pipelining admission behavior
+            self._drain_to(self.pipeline_depth - 1, finished)
 
     def _retire(self, slot: int, reason: str, now: float,
                 finished: list[RequestOutput]) -> None:
@@ -525,15 +701,13 @@ class ServingEngine:
         finished.append(out)
 
     def _release_slot(self, slot: int) -> None:
-        """Return a slot to the free pool, zeroing its per-slot data arrays
-        (the cache buffer itself needs no reset — the next admission's write
-        index restart makes the stale entries unreachable)."""
+        """Return a slot to the free pool. Device state needs no touch-up:
+        the slot is frozen by its on-device finished mask (or, for a cancel,
+        burns out harmlessly against its token budget), lagged in-flight
+        results are invalidated by the generation bump, and the next
+        admission's scatter rewrites every per-slot array."""
         self._slot_req[slot] = None
         self._slot_out[slot] = None
         self._active[slot] = False
-        self._pos[slot] = 0
-        self._tokens[slot] = 0
-        self._temps[slot] = 0.0
-        self._topks[slot] = 0
-        self._budget[slot] = 0
+        self._slot_gen[slot] += 1
         self._free.append(slot)

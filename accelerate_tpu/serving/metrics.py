@@ -83,8 +83,13 @@ class ServingMetrics:
 
     Latency histograms are in seconds: ``ttft_s`` (submit -> first token),
     ``inter_token_s`` (gap between consecutive tokens of one request),
-    ``request_latency_s`` (submit -> finish). ``queue_depth`` and
-    ``slot_occupancy`` are sampled once per engine step.
+    ``request_latency_s`` (submit -> finish), ``host_blocked_s`` (time the
+    host spent blocked in ``device_get`` per pipelined fetch — THE number the
+    pipelined dispatch exists to shrink). ``queue_depth`` and
+    ``slot_occupancy`` are sampled once per engine step; ``dispatch_depth``
+    (in-flight dispatches at each decode dispatch, 1 = synchronous) and
+    ``admit_batch_size`` (requests per batched prefill call) are sampled at
+    each dispatch/admission.
     """
 
     def __init__(self):
@@ -104,8 +109,11 @@ class ServingMetrics:
         self.ttft_s = Histogram()
         self.inter_token_s = Histogram()
         self.request_latency_s = Histogram()
+        self.host_blocked_s = Histogram()
         self.queue_depth = Histogram()
         self.slot_occupancy = Histogram()
+        self.dispatch_depth = Histogram()
+        self.admit_batch_size = Histogram()
         self._start: float | None = None
 
     def mark_start(self) -> None:
@@ -143,8 +151,11 @@ class ServingMetrics:
             ("ttft_s", self.ttft_s),
             ("inter_token_s", self.inter_token_s),
             ("request_latency_s", self.request_latency_s),
+            ("host_blocked_s", self.host_blocked_s),
             ("queue_depth", self.queue_depth),
             ("slot_occupancy", self.slot_occupancy),
+            ("dispatch_depth", self.dispatch_depth),
+            ("admit_batch_size", self.admit_batch_size),
         ):
             for stat, value in hist.summary().items():
                 out[f"serving/{name}/{stat}"] = value

@@ -71,6 +71,26 @@ class FIFOScheduler:
         """Pop the oldest queued request (FIFO), or None when idle."""
         return self._queue.popleft() if self._queue else None
 
+    def peek_run(self, max_n: int) -> int:
+        """Length (up to ``max_n``) of the contiguous run of queued requests at
+        the FRONT that share the head's prompt bucket — the group one batched
+        admission call can prefill together. Only the front run counts:
+        skipping past a differently-bucketed head to batch later arrivals
+        would break FIFO fairness."""
+        if not self._queue or max_n <= 0:
+            return 0
+        head_bucket = self.bucket_for(len(self._queue[0].prompt))
+        n = 0
+        for r in self._queue:
+            if n >= max_n or self.bucket_for(len(r.prompt)) != head_bucket:
+                break
+            n += 1
+        return n
+
+    def pop_run(self, n: int) -> list[Request]:
+        """Pop the ``n`` front requests (the group sized via `peek_run`)."""
+        return [self._queue.popleft() for _ in range(min(n, len(self._queue)))]
+
     def requeue(self, request: Request) -> None:
         """Put a request at the FRONT of the queue (the watchdog's re-prefill
         path: a quarantined request must not wait behind new arrivals)."""

@@ -9,16 +9,26 @@ recycles a slot the moment its request completes — the win measured here is
 exactly the padded/lockstep waste, so it grows with the skew of the
 ``max_new_tokens`` distribution.
 
+The engine runs TWICE — ``pipeline_depth=1`` (synchronous dispatch) and
+``pipeline_depth=BENCH_SERVE_DEPTH`` (pipelined) — so the dispatch-overlap win
+is measured directly: host-blocked time per decode step (the seconds
+``step()`` spends stalled in ``device_get``) must be strictly lower at depth 2,
+and inter-token latency p50/p99 ride along with TTFT/tokens-per-sec.
+
 Both sides run one warm pass first (compiles excluded) and count only the
-tokens requests actually asked for. Prints ONE JSON line:
+tokens requests actually asked for. Prints ONE machine-readable JSON line
+(`tools/bench_sweep.py` consumes it via a BENCH_SCRIPT overlay):
 {"metric": "serving_tokens_per_sec", "value", "unit", "vs_baseline", "detail"}
-with vs_baseline = engine_tps / lockstep_tps (>1.0 = continuous batching wins).
+with vs_baseline = pipelined_tps / lockstep_tps (>1.0 = continuous batching
+wins); detail carries engine_depth1/engine_pipelined/lockstep breakdowns.
 
 Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_REQUESTS     trace length (default 32)
   BENCH_SERVE_CONCURRENCY  engine slots == lockstep batch size (default 8)
   BENCH_SERVE_RATE         Poisson arrival rate, req/s (default 200: saturating)
   BENCH_SERVE_SEED         trace rng seed (default 0)
+  BENCH_SERVE_DEPTH        pipelined run's pipeline_depth (default 2)
+  BENCH_SERVE_ADMIT        admit_batch for both engine runs (default 4)
 
 Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
 """
@@ -83,8 +93,15 @@ def _run_engine(engine, trace) -> tuple[float, float, dict]:
     tokens = sum(r.params.max_new_tokens for r in trace)
     assert done == len(trace)
     m = engine.metrics
+    steps = max(m.steps.value, 1)
     return tokens / dt, dt, {
         "ttft_p50_s": round(m.ttft_s.quantile(0.5), 4),
+        "itl_p50_s": round(m.inter_token_s.quantile(0.5), 5),
+        "itl_p99_s": round(m.inter_token_s.quantile(0.99), 5),
+        # THE pipelining number: seconds/step the host spent stalled in
+        # device_get (total blocked time normalized by decode steps, so
+        # depth-1 and depth-2 runs compare directly)
+        "host_blocked_per_step_s": round(m.host_blocked_s.sum / steps, 6),
         "slot_occupancy_mean": round(m.slot_occupancy.mean, 3),
         "steps": m.steps.value,
     }
@@ -117,6 +134,8 @@ def main() -> None:
     concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 8)
     rate = float(os.environ.get("BENCH_SERVE_RATE", 200.0))
     seed = _env_int("BENCH_SERVE_SEED", 0)
+    depth = _env_int("BENCH_SERVE_DEPTH", 2)
+    admit = _env_int("BENCH_SERVE_ADMIT", 4)
 
     # mid-size on purpose: per-token compute must dominate per-call dispatch,
     # as it does for any real serving model — a toy config measures python
@@ -126,33 +145,45 @@ def main() -> None:
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0))
     trace = _trace(n_requests, rate, seed, cfg.vocab_size)
-    engine = ServingEngine(module, params, max_concurrency=concurrency,
-                           prompt_buckets=BUCKETS, max_queue=len(trace) + 1)
-
-    # warm passes on the SAME engine/jit caches: compile every bucket and the
-    # decode step outside the timed region (generate's jit cache is module-level
-    # and persists on its own)
-    _run_engine(engine, trace)
-    _run_lockstep(module, params, trace, concurrency)
 
     from accelerate_tpu.serving import ServingMetrics
 
-    engine.metrics = ServingMetrics()  # drop the warm pass from the timed stats
-    engine_tps, engine_dt, engine_detail = _run_engine(engine, trace)
+    def timed_engine(pipeline_depth):
+        # warm pass on the SAME engine/jit caches: compile every (prompt,
+        # batch) bucket and the decode step outside the timed region
+        engine = ServingEngine(module, params, max_concurrency=concurrency,
+                               prompt_buckets=BUCKETS, max_queue=len(trace) + 1,
+                               pipeline_depth=pipeline_depth, admit_batch=admit)
+        _run_engine(engine, trace)
+        engine.metrics = ServingMetrics()  # drop the warm pass from the stats
+        return _run_engine(engine, trace)
+
+    sync_tps, sync_dt, sync_detail = timed_engine(1)
+    pipe_tps, pipe_dt, pipe_detail = timed_engine(depth)
+    # lockstep baseline (generate's jit cache is module-level and persists)
+    _run_lockstep(module, params, trace, concurrency)
     lock_tps, lock_dt, lock_detail = _run_lockstep(module, params, trace, concurrency)
 
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
-        "value": round(engine_tps, 2),
+        "value": round(pipe_tps, 2),
         "unit": "tokens/s",
-        "vs_baseline": round(engine_tps / lock_tps, 3),
+        "vs_baseline": round(pipe_tps / lock_tps, 3),
         "detail": {
             "platform": jax.devices()[0].platform,
             "requests": n_requests,
             "concurrency": concurrency,
             "poisson_rate": rate,
-            "engine": {"tokens_per_sec": round(engine_tps, 2),
-                       "wall_s": round(engine_dt, 3), **engine_detail},
+            "pipeline_depth": depth,
+            "admit_batch": admit,
+            "vs_depth1": round(pipe_tps / sync_tps, 3),
+            "host_blocked_ratio_d2_over_d1": round(
+                pipe_detail["host_blocked_per_step_s"]
+                / max(sync_detail["host_blocked_per_step_s"], 1e-9), 3),
+            "engine_depth1": {"tokens_per_sec": round(sync_tps, 2),
+                              "wall_s": round(sync_dt, 3), **sync_detail},
+            "engine_pipelined": {"tokens_per_sec": round(pipe_tps, 2),
+                                 "wall_s": round(pipe_dt, 3), **pipe_detail},
             "lockstep": {"tokens_per_sec": round(lock_tps, 2),
                          "wall_s": round(lock_dt, 3), **lock_detail},
         },

@@ -19,6 +19,11 @@ def pytest_configure(config):
         "fault: deterministic fault-injection tests (reliability layer; "
         "seeded, so stable under tier-1's -p no:randomly)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serving: continuous-batching serving engine tests — the standalone "
+        "serving suite is `pytest -m serving`",
+    )
 
 
 @pytest.fixture

@@ -10,6 +10,10 @@ Usage: python tools/bench_sweep.py [out.jsonl] [configs.json]
 Configs come from SWEEP below (or a JSON list of env-overlay dicts passed as
 the second argument — used to resume an interrupted sweep with only the
 unmeasured rows); each entry is the env overlay for one `python bench.py` run.
+An overlay may carry a ``BENCH_SCRIPT`` key naming a different repo-root-
+relative bench entrypoint — e.g. ``{"BENCH_SCRIPT": "benchmarks/bench_serving.py",
+"BENCH_SERVE_DEPTH": "2"}`` sweeps serving runs; every entrypoint emits the
+same one-JSON-line contract, so the record format does not change.
 """
 
 from __future__ import annotations
@@ -87,7 +91,8 @@ def main() -> None:
         env["BENCH_NO_OVERLAY"] = "1"
         env.update(overlay)
         print(f"[sweep] run {i + 1}/{len(sweep)}: {label}", flush=True)
-        bench_path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        bench_path = os.path.join(root, overlay.get("BENCH_SCRIPT", "bench.py"))
         try:
             run = subprocess.run(
                 [sys.executable, bench_path], env=env,

@@ -25,6 +25,9 @@ Env knobs:
   CHAOS_POISON_EVERY    poison slot 0 every N decode steps (default 5; 0 = off)
   CHAOS_DEADLINE_EVERY  every N-th request gets a deadline (default 6; 0 = off)
   CHAOS_DEADLINE_S      that deadline, seconds of queue wait (default 0.0)
+  CHAOS_DEPTH           engine pipeline_depth (default 2: the replay must prove
+                        the zero-lost guarantee survives LAGGED retirement —
+                        set 1 to bisect a failure against synchronous dispatch)
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ def run(
     deadline_s: float = 0.0,
     module=None,
     params=None,
+    pipeline_depth: int = 2,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     return the summary dict (importable — tests/test_reliability.py runs it)."""
@@ -77,7 +81,8 @@ def run(
         ))
     injector = FaultInjector(seed=seed, specs=specs)
     engine = ServingEngine(module, params, max_concurrency=concurrency,
-                           prompt_buckets=BUCKETS, max_queue=n_requests + 1)
+                           prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+                           pipeline_depth=pipeline_depth)
 
     submitted: dict[int, str] = {}
     terminal: dict[int, str] = {}
@@ -118,6 +123,7 @@ def run(
             "concurrency": concurrency,
             "poisson_rate": rate,
             "seed": seed,
+            "pipeline_depth": pipeline_depth,
             "terminal_reasons": reasons,
             "steps": m.steps.value,
             "steps_poisoned": m.steps_poisoned.value,
@@ -137,6 +143,7 @@ def main() -> None:
         poison_every=_env_int("CHAOS_POISON_EVERY", 5),
         deadline_every=_env_int("CHAOS_DEADLINE_EVERY", 6),
         deadline_s=float(os.environ.get("CHAOS_DEADLINE_S", 0.0)),
+        pipeline_depth=_env_int("CHAOS_DEPTH", 2),
     )
     print(json.dumps(summary), flush=True)
 
