@@ -150,6 +150,77 @@ def decode_cache_update(
     return k_all, v_all, idx, True
 
 
+def _is_index_leaf(path) -> bool:
+    return getattr(path[-1], "key", None) == "cache_index"
+
+
+def make_block_pool(cache: Any, num_blocks: int, block_tokens: int) -> Any:
+    """Allocate the device-resident block pool for prefix KV reuse
+    (`serving/prefix_cache.py`): a pytree mirroring a per-slot cache, but with
+    every KV leaf carved into ``[num_blocks, block_tokens, ...]`` fixed-size
+    blocks instead of ``[B, n_positions, ...]`` slot rows.
+
+    ``cache_index`` leaves become per-block placeholders (the pool has no
+    write cursor — block occupancy lives in the host-side radix trie); they
+    exist only so the pool shares the cache's treedef and one ``tree_map``
+    drives every gather/scatter.
+    """
+
+    def alloc(path, leaf):
+        if _is_index_leaf(path):
+            return jnp.zeros((num_blocks,), leaf.dtype)
+        return jnp.zeros((num_blocks, block_tokens) + leaf.shape[2:], leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(alloc, cache)
+
+
+def gather_block_rows(
+    block_pool: Any,  # [num_blocks, block_tokens, ...] pool pytree
+    block_tables: jax.Array,  # [nb, blocks_per_row] int32 pool block ids
+    cache_index: jax.Array,  # [nb] int32 resume index (the cached prefix length)
+) -> Any:
+    """Assemble ``nb`` cache rows from pool blocks in ONE gather per leaf: row
+    ``i`` is ``block_tables[i]``'s blocks concatenated along the token axis
+    (``blocks_per_row * block_tokens`` positions — the engine sizes the table
+    so this equals ``n_positions``). Table entries past a row's real prefix
+    may point anywhere valid: the positions they fill are overwritten by the
+    suffix prefill or masked out of attention before anything reads them.
+    ``cache_index`` leaves are set to ``cache_index`` so the suffix prefill
+    writes (and attends) from each row's cached-prefix end.
+    """
+
+    def gather(path, leaf):
+        if _is_index_leaf(path):
+            return cache_index.astype(leaf.dtype)
+        rows = leaf[block_tables]  # [nb, blocks_per_row, block_tokens, ...]
+        return rows.reshape((rows.shape[0], rows.shape[1] * rows.shape[2]) + rows.shape[3:])
+
+    return jax.tree_util.tree_map_with_path(gather, block_pool)
+
+
+def scatter_block_rows(
+    block_pool: Any,  # [num_blocks, block_tokens, ...] pool pytree
+    cache: Any,  # the [B, n_positions, ...] slot-pool cache pytree
+    slot: jax.Array,  # scalar int32 slot row to donate from
+    dest_blocks: jax.Array,  # [n_positions // block_tokens] int32 pool ids; >= num_blocks drops
+) -> Any:
+    """Donate one slot row's KV into pool blocks in ONE scatter per leaf (the
+    prefix cache's retire-time donation). ``dest_blocks[j]`` is where the
+    row's ``j``-th block lands; entries pointing past the pool (``num_blocks``)
+    are dropped — that is how already-present trie blocks and the region past
+    the donated prefix are skipped without a second compile."""
+
+    def scatter(path, pool_leaf, cache_leaf):
+        if _is_index_leaf(path):
+            return pool_leaf
+        row = cache_leaf[slot]  # [n_positions, ...]
+        n_blocks = dest_blocks.shape[0]
+        blocks = row.reshape((n_blocks, row.shape[0] // n_blocks) + row.shape[1:])
+        return pool_leaf.at[dest_blocks].set(blocks, mode="drop")
+
+    return jax.tree_util.tree_map_with_path(scatter, block_pool, cache)
+
+
 def scatter_cache_slots(
     pool_cache: Any,  # the [B, ...] slot-pool cache pytree
     new_cache: Any,  # an [nb, ...] freshly prefilled cache pytree

@@ -8,6 +8,7 @@ counters/histograms exported through the `tracking.py` tracker interface.
 
 from .engine import ServingEngine
 from .metrics import Counter, Histogram, ServingMetrics
+from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .request import (
     FINISH_ABORTED,
     FINISH_EOS,
@@ -26,6 +27,8 @@ from .scheduler import FIFOScheduler
 
 __all__ = [
     "ServingEngine",
+    "PrefixCache",
+    "PrefixCacheConfig",
     "ServingMetrics",
     "Counter",
     "Histogram",

@@ -14,6 +14,11 @@ decode step instead (the serving half of the ROADMAP north star):
     bucket in a SINGLE jitted call (one compile per ``(prompt_bucket,
     batch_bucket)`` pair), samples their first tokens, and scatters all the
     new slots into the pool at once (`kv_cache.scatter_cache_slots`);
+  - with ``prefix_cache=`` enabled, admission first reuses any cached prompt
+    prefix from a device-resident block pool (`serving/prefix_cache.py`):
+    matched blocks are gathered into the slot's rows and only the uncached
+    suffix is prefilled (re-bucketed, so compiles stay bounded); retirement
+    donates finished prompts back. Token streams are identical either way;
   - ``step()`` decodes ALL slots in one jitted call with donated cache
     buffers; per-slot positions, sampling params, rng keys, remaining budget,
     and the finished mask are DEVICE-RESIDENT ``[max_concurrency]`` arrays,
@@ -55,9 +60,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.kv_cache import scatter_cache_slots
+from ..models.kv_cache import gather_block_rows, scatter_cache_slots
 from ..reliability.faults import ALL_SLOTS, active_injector
 from .metrics import ServingMetrics
+from .prefix_cache import NO_MATCH, PrefixCache, PrefixCacheConfig, PrefixMatch
 from .request import (
     FINISH_ABORTED,
     FINISH_EOS,
@@ -147,6 +153,7 @@ class ServingEngine:
         eos_token_id: int | None = None,
         pipeline_depth: int = 2,
         admit_batch: int = 4,
+        prefix_cache: PrefixCacheConfig | bool = False,
         tracker: Any = None,
         metrics_log_every: int = 0,
         metrics: ServingMetrics | None = None,
@@ -231,6 +238,23 @@ class ServingEngine:
         self._step_count = 0
         self._vocab = int(getattr(module.config, "vocab_size", 0) or 0)
         self._draining = False
+        # prefix KV reuse (serving/prefix_cache.py): admission skips prefill
+        # of prompt prefixes already resident in the block pool, retirement
+        # donates finished prompts back. Off by default — the cache-off
+        # engine's compiled programs are bit-for-bit the pre-PR-4 ones.
+        self.prefix_cache: PrefixCache | None = None
+        self._slot_match: list[PrefixMatch | None] = [None] * b
+        self._slot_hit = np.zeros(b, bool)
+        if prefix_cache:
+            pc_cfg = (prefix_cache if isinstance(prefix_cache, PrefixCacheConfig)
+                      else PrefixCacheConfig())
+            self.prefix_cache = PrefixCache(
+                self._cache, max_len=self.max_len,
+                block_tokens=pc_cfg.block_tokens, num_blocks=pc_cfg.num_blocks,
+                metrics=self.metrics,
+            )
+            self.scheduler.prefill_len_fn = self._prefill_len
+            self._cached_admit_fn = self._build_cached_admit_fn()
         self._step_fn = self._build_step_fn()
         self._admit_fn = self._build_admit_fn()
 
@@ -324,6 +348,65 @@ class ServingEngine:
                     d_finished, d_remaining, rng_data)
 
         return jax.jit(admit_fn, donate_argnums=(0,))
+
+    def _build_cached_admit_fn(self):
+        """Admission with prefix reuse: gather each row's matched blocks out
+        of the prefix pool into its cache rows, prefill ONLY the uncached
+        suffix (each row resuming at its own ``cached_len`` via the [nb]
+        ``position_offset`` vector), and scatter into the slot pool exactly
+        like plain admission. One compile per ``(suffix_bucket, batch_bucket)``
+        pair — the same bounded set as plain admission, because the scheduler
+        re-buckets the SUFFIX (`FIFOScheduler.prefill_bucket_for`)."""
+        module = self.module
+
+        def admit_fn(pool_cache, params, block_pool, block_tables, cached_lens,
+                     suffix_rows, suffix_lens, slots, temps, top_ks, rng_batch,
+                     budgets, d_tokens, d_pos, d_temps, d_topks, d_finished,
+                     d_remaining, rng_data, eos_id):
+            # rows assembled from pool blocks; table entries past a row's real
+            # prefix fill positions the suffix write overwrites or the causal
+            # mask (kv_pos <= cached_len + j) never lets a query read
+            fresh = gather_block_rows(block_pool, block_tables, cached_lens)
+            logits, mutated = module.apply(
+                {"params": params, "cache": fresh}, suffix_rows, decode=True,
+                position_offset=cached_lens, mutable=["cache"],
+            )
+            last = jax.vmap(
+                lambda row, n: jax.lax.dynamic_slice(
+                    row, (n - 1, 0), (1, row.shape[-1])
+                )[0]
+            )(logits, suffix_lens)
+            rngs = jax.random.wrap_key_data(rng_batch)
+            split = jax.vmap(jax.random.split)(rngs)  # [nb, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            first = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            # decode resumes from the FULL prompt end: cached prefix + suffix
+            prompt_lens = cached_lens + suffix_lens
+            new_pool = scatter_cache_slots(
+                pool_cache, mutated["cache"], slots, prompt_lens
+            )
+            rem0 = budgets - 1
+            fin0 = (rem0 <= 0) | ((eos_id >= 0) & (first == eos_id))
+            d_tokens = d_tokens.at[slots].set(first)
+            d_pos = d_pos.at[slots].set(prompt_lens)
+            d_temps = d_temps.at[slots].set(temps)
+            d_topks = d_topks.at[slots].set(top_ks)
+            d_finished = d_finished.at[slots].set(fin0)
+            d_remaining = d_remaining.at[slots].set(rem0)
+            rng_data = rng_data.at[slots].set(jax.random.key_data(new_rngs))
+            return (new_pool, first, fin0, d_tokens, d_pos, d_temps, d_topks,
+                    d_finished, d_remaining, rng_data)
+
+        return jax.jit(admit_fn, donate_argnums=(0,))
+
+    def _prefill_len(self, request: Request) -> int:
+        """Scheduler probe: prompt tokens admission would actually prefill for
+        this request right now (its uncached suffix) — the grouping key for
+        suffix-bucketed batched admission. Probing never pins; the real match
+        re-walks (and pins) at admission."""
+        if not request.cache_prefix:
+            return len(request.prompt)
+        return len(request.prompt) - self.prefix_cache.match_len(request.prompt)
 
     # --------------------------------------------------------------- requests
     def submit(self, request: Request | Iterable[int],
@@ -566,7 +649,11 @@ class ServingEngine:
             request = self._slot_req[slot]
             out.first_token_time = now
             if request.arrival_time is not None:
-                self.metrics.ttft_s.observe(max(0.0, now - request.arrival_time))
+                ttft = max(0.0, now - request.arrival_time)
+                self.metrics.ttft_s.observe(ttft)
+                if self.prefix_cache is not None and request.cache_prefix:
+                    (self.metrics.ttft_hit_s if self._slot_hit[slot]
+                     else self.metrics.ttft_miss_s).observe(ttft)
             token = int(tokens[i])
             out.tokens.append(token)
             self.metrics.tokens_generated.inc()
@@ -639,55 +726,157 @@ class ServingEngine:
                 return
             nb = max(s for s in self._admit_sizes if s <= run_len)
             group = self.scheduler.pop_run(nb)
-            slots = [self._free.popleft() for _ in group]
-            bucket = self.scheduler.bucket_for(max(len(r.prompt) for r in group))
-            padded = np.zeros((nb, bucket), np.int32)
-            lens = np.zeros(nb, np.int32)
-            temps = np.zeros(nb, np.float32)
-            topks = np.zeros(nb, np.int32)
-            budgets = np.zeros(nb, np.int32)
-            rng_rows = []
-            for i, request in enumerate(group):
-                plen = len(request.prompt)
-                padded[i, :plen] = request.prompt
-                lens[i] = plen
-                sp = request.params
-                temps[i] = sp.temperature
-                topks[i] = sp.top_k or 0
-                # the context is fixed-size: cap generation so cache writes
-                # stay inside [0, n_positions)
-                budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen)
-                rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
-            (self._cache, first, fin0, self._d_tokens, self._d_pos,
-             self._d_temps, self._d_topks, self._d_finished,
-             self._d_remaining, self._rng_data) = self._admit_fn(
-                self._cache, self.params, jnp.asarray(padded),
-                jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
-                jnp.asarray(temps), jnp.asarray(topks),
-                jnp.stack(rng_rows), jnp.asarray(budgets),
-                self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
-                self._d_finished, self._d_remaining, self._rng_data,
-                self._d_eos,
+            if self.prefix_cache is not None:
+                # pin NOW: nothing mutates the trie between the peek_run probe
+                # and this acquire, so the match agrees with the suffix bucket
+                # the group was sized by
+                matches = [
+                    self.prefix_cache.acquire(r.prompt) if r.cache_prefix
+                    else NO_MATCH
+                    for r in group
+                ]
+                if any(m.tokens for m in matches):
+                    self._admit_group_cached(group, matches, finished)
+                    continue
+                for r in group:
+                    if r.cache_prefix:
+                        self.metrics.prefix_misses.inc()
+            # all-miss (or cache off): the plain admission program — with the
+            # prefix cache disabled this path is bit-for-bit the pre-cache one
+            self._admit_group(group, finished)
+
+    def _admit_group(self, group: list[Request],
+                     finished: list[RequestOutput]) -> None:
+        nb = len(group)
+        slots = [self._free.popleft() for _ in group]
+        bucket = self.scheduler.bucket_for(max(len(r.prompt) for r in group))
+        padded = np.zeros((nb, bucket), np.int32)
+        lens = np.zeros(nb, np.int32)
+        temps = np.zeros(nb, np.float32)
+        topks = np.zeros(nb, np.int32)
+        budgets = np.zeros(nb, np.int32)
+        rng_rows = []
+        for i, request in enumerate(group):
+            plen = len(request.prompt)
+            padded[i, :plen] = request.prompt
+            lens[i] = plen
+            sp = request.params
+            temps[i] = sp.temperature
+            topks[i] = sp.top_k or 0
+            # the context is fixed-size: cap generation so cache writes
+            # stay inside [0, n_positions)
+            budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen)
+            rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
+        (self._cache, first, fin0, self._d_tokens, self._d_pos,
+         self._d_temps, self._d_topks, self._d_finished,
+         self._d_remaining, self._rng_data) = self._admit_fn(
+            self._cache, self.params, jnp.asarray(padded),
+            jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
+            jnp.asarray(temps), jnp.asarray(topks),
+            jnp.stack(rng_rows), jnp.asarray(budgets),
+            self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
+            self._d_finished, self._d_remaining, self._rng_data,
+            self._d_eos,
+        )
+        self.metrics.prefill_tokens.inc(int(lens.sum()))
+        self.metrics.admit_batch_size.observe(nb)
+        self._finish_admit(group, None, slots, (first, fin0), finished)
+
+    def _admit_group_cached(self, group: list[Request],
+                            matches: list[PrefixMatch],
+                            finished: list[RequestOutput]) -> None:
+        pc = self.prefix_cache
+        nb = len(group)
+        # context guard: `dynamic_update_slice` CLAMPS out-of-range starts, so
+        # a row whose cached prefix plus padded suffix bucket overran
+        # n_positions would silently shift its suffix write backwards over the
+        # prefix — trim the match instead. Trimming grows that suffix, which
+        # can grow the shared bucket and push OTHER rows over; iterate to a
+        # fixed point (the bucket only grows and matches only shrink, so this
+        # terminates — in the worst case at tokens=0 == plain admission).
+        while True:
+            bucket = self.scheduler.bucket_for(
+                max(len(r.prompt) - m.tokens for r, m in zip(group, matches))
             )
-            gens = []
-            for slot, request in zip(slots, group):
-                self._slot_gen[slot] += 1
-                gens.append(int(self._slot_gen[slot]))
-                self._slot_req[slot] = request
-                self._slot_out[slot] = RequestOutput(
-                    request_id=request.request_id, prompt_len=len(request.prompt),
-                    tokens=[], finish_reason="", arrival_time=request.arrival_time,
-                )
-                self._active[slot] = True
-            self.metrics.prefill_tokens.inc(int(lens.sum()))
-            self.metrics.admit_batch_size.observe(nb)
-            self._inflight.append(_Inflight(
-                "admit", (first, fin0), tuple(slots), tuple(gens)
-            ))
-            # at depth 1 this fetches the first tokens NOW — an EOS or 1-token
-            # budget frees its slot before the next group is sized, exactly
-            # the pre-pipelining admission behavior
-            self._drain_to(self.pipeline_depth - 1, finished)
+            over = [i for i, m in enumerate(matches)
+                    if m.tokens and m.tokens + bucket > self.max_len]
+            if not over:
+                break
+            keep = max(0, (self.max_len - bucket) // pc.block_tokens)
+            for i in over:
+                matches[i] = pc.trim(matches[i], keep)
+        slots = [self._free.popleft() for _ in group]
+        padded = np.zeros((nb, bucket), np.int32)
+        suffix_lens = np.zeros(nb, np.int32)
+        cached_lens = np.zeros(nb, np.int32)
+        tables = np.zeros((nb, pc.blocks_per_row), np.int32)
+        temps = np.zeros(nb, np.float32)
+        topks = np.zeros(nb, np.int32)
+        budgets = np.zeros(nb, np.int32)
+        rng_rows = []
+        for i, (request, m) in enumerate(zip(group, matches)):
+            plen = len(request.prompt)
+            suffix = request.prompt[m.tokens:]
+            padded[i, :len(suffix)] = suffix
+            suffix_lens[i] = len(suffix)
+            cached_lens[i] = m.tokens
+            if m.block_ids:
+                tables[i, :len(m.block_ids)] = m.block_ids
+            sp = request.params
+            temps[i] = sp.temperature
+            topks[i] = sp.top_k or 0
+            # budget depends on the FULL prompt length — token identity with
+            # the cold path requires the same generation cap either way
+            budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen)
+            rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
+            if m.tokens:
+                self.metrics.prefix_hits.inc()
+                self.metrics.prefix_tokens_reused.inc(m.tokens)
+            elif request.cache_prefix:
+                self.metrics.prefix_misses.inc()
+        (self._cache, first, fin0, self._d_tokens, self._d_pos,
+         self._d_temps, self._d_topks, self._d_finished,
+         self._d_remaining, self._rng_data) = self._cached_admit_fn(
+            self._cache, self.params, pc.pool, jnp.asarray(tables),
+            jnp.asarray(cached_lens), jnp.asarray(padded),
+            jnp.asarray(suffix_lens),
+            jnp.asarray(np.asarray(slots, np.int32)),
+            jnp.asarray(temps), jnp.asarray(topks), jnp.stack(rng_rows),
+            jnp.asarray(budgets), self._d_tokens, self._d_pos, self._d_temps,
+            self._d_topks, self._d_finished, self._d_remaining,
+            self._rng_data, self._d_eos,
+        )
+        # only the uncached suffixes hit the model — that delta is the point
+        self.metrics.prefill_tokens.inc(int(suffix_lens.sum()))
+        self.metrics.admit_batch_size.observe(nb)
+        self._finish_admit(group, matches, slots, (first, fin0), finished)
+
+    def _finish_admit(self, group: list[Request],
+                      matches: list[PrefixMatch] | None, slots: list[int],
+                      arrays: tuple, finished: list[RequestOutput]) -> None:
+        gens = []
+        for i, (slot, request) in enumerate(zip(slots, group)):
+            self._slot_gen[slot] += 1
+            gens.append(int(self._slot_gen[slot]))
+            self._slot_req[slot] = request
+            self._slot_out[slot] = RequestOutput(
+                request_id=request.request_id, prompt_len=len(request.prompt),
+                tokens=[], finish_reason="", arrival_time=request.arrival_time,
+            )
+            self._active[slot] = True
+            if matches is not None:
+                m = matches[i]
+                # pins travel with the slot; released at retirement. The plain
+                # path leaves the _release_slot defaults (no match, miss).
+                self._slot_match[slot] = m if m.nodes else None
+                self._slot_hit[slot] = bool(m.tokens)
+        self._inflight.append(_Inflight(
+            "admit", arrays, tuple(slots), tuple(gens)
+        ))
+        # at depth 1 this fetches the first tokens NOW — an EOS or 1-token
+        # budget frees its slot before the next group is sized, exactly
+        # the pre-pipelining admission behavior
+        self._drain_to(self.pipeline_depth - 1, finished)
 
     def _retire(self, slot: int, reason: str, now: float,
                 finished: list[RequestOutput]) -> None:
@@ -697,6 +886,16 @@ class ServingEngine:
         if out.arrival_time is not None:
             self.metrics.request_latency_s.observe(max(0.0, now - out.arrival_time))
         self.metrics.requests_finished.inc()
+        if (self.prefix_cache is not None and reason != FINISH_ERROR
+                and self._slot_req[slot].cache_prefix):
+            # donate the retired slot's prompt-region KV to the prefix pool.
+            # Safe under pipelining: decode writes land at >= prompt_len and a
+            # finished slot is frozen by its on-device mask, so [0, prompt_len)
+            # is exactly the admission-time prefill whenever we get here. A
+            # FINISH_ERROR slot is poisoned — never donate it.
+            self.prefix_cache.insert(
+                self._slot_req[slot].prompt, self._cache, slot
+            )
         self._release_slot(slot)
         finished.append(out)
 
@@ -706,6 +905,10 @@ class ServingEngine:
         burns out harmlessly against its token budget), lagged in-flight
         results are invalidated by the generation bump, and the next
         admission's scatter rewrites every per-slot array."""
+        if self.prefix_cache is not None and self._slot_match[slot] is not None:
+            self.prefix_cache.release(self._slot_match[slot])
+        self._slot_match[slot] = None
+        self._slot_hit[slot] = False
         self._slot_req[slot] = None
         self._slot_out[slot] = None
         self._active[slot] = False

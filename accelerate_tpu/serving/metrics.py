@@ -33,18 +33,28 @@ class Histogram:
     def __init__(self, max_samples: int = 4096):
         self.count = 0
         self.sum = 0.0
-        self.min = math.inf
-        self.max = -math.inf
+        self._min = math.inf
+        self._max = -math.inf
         self._max_samples = int(max_samples)
         self._stride = 1
         self._samples: list[float] = []
+
+    @property
+    def min(self) -> float:
+        """Smallest observed value; 0.0 before any observation — the inf/-inf
+        sentinels must never escape into exports (JSONL/W&B reject them)."""
+        return self._min if self.count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self.count else 0.0
 
     def observe(self, value: float) -> None:
         value = float(value)
         self.count += 1
         self.sum += value
-        self.min = min(self.min, value)
-        self.max = max(self.max, value)
+        self._min = min(self._min, value)
+        self._max = max(self._max, value)
         if self.count % self._stride == 0:
             self._samples.append(value)
             if len(self._samples) > self._max_samples:
@@ -105,8 +115,21 @@ class ServingMetrics:
         self.steps_poisoned = Counter()
         self.tokens_generated = Counter()
         self.prefill_tokens = Counter()
+        # prefix-cache telemetry (serving/prefix_cache.py): admissions that
+        # reused >= 1 cached block vs. those that matched nothing, prompt
+        # tokens whose prefill was skipped, blocks donated on retirement, and
+        # blocks LRU-evicted under pool pressure
+        self.prefix_hits = Counter()
+        self.prefix_misses = Counter()
+        self.prefix_tokens_reused = Counter()
+        self.prefix_blocks_donated = Counter()
+        self.prefix_evictions = Counter()
         self.steps = Counter()
         self.ttft_s = Histogram()
+        # TTFT split by prefix-cache outcome: the hit histogram is the
+        # headline number prefix reuse exists to shrink
+        self.ttft_hit_s = Histogram()
+        self.ttft_miss_s = Histogram()
         self.inter_token_s = Histogram()
         self.request_latency_s = Histogram()
         self.host_blocked_s = Histogram()
@@ -144,11 +167,18 @@ class ServingMetrics:
             "serving/steps_poisoned": self.steps_poisoned.value,
             "serving/tokens_generated": self.tokens_generated.value,
             "serving/prefill_tokens": self.prefill_tokens.value,
+            "serving/prefix_hits": self.prefix_hits.value,
+            "serving/prefix_misses": self.prefix_misses.value,
+            "serving/prefix_tokens_reused": self.prefix_tokens_reused.value,
+            "serving/prefix_blocks_donated": self.prefix_blocks_donated.value,
+            "serving/prefix_evictions": self.prefix_evictions.value,
             "serving/steps": self.steps.value,
             "serving/tokens_per_sec": self.tokens_per_sec(),
         }
         for name, hist in (
             ("ttft_s", self.ttft_s),
+            ("ttft_hit_s", self.ttft_hit_s),
+            ("ttft_miss_s", self.ttft_miss_s),
             ("inter_token_s", self.inter_token_s),
             ("request_latency_s", self.request_latency_s),
             ("host_blocked_s", self.host_blocked_s),

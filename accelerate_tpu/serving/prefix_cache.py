@@ -1,0 +1,281 @@
+"""Prefix KV-cache reuse: a device-resident block pool behind a host radix trie.
+
+Production traffic is dominated by shared prefixes — system prompts, few-shot
+templates, multi-turn history — yet the serving engine (pre-PR-4) recomputed
+every admitted prompt from token 0. This module lets admission skip the
+shared part (SGLang-style RadixAttention, adapted to this stack's
+static-shape discipline):
+
+  - the KV pool is carved into fixed-size **blocks** of ``block_tokens``
+    tokens (power of two, default 16), allocated once on device as a
+    ``[num_blocks, block_tokens, ...]`` pytree mirroring the engine's slot
+    cache (`models/kv_cache.make_block_pool`) — int8 storage rides along
+    bit-exactly because blocks are copied, never recomputed;
+  - a host-side **radix trie** maps token-id prefixes to blocks at block
+    granularity: one trie node per block, keyed by that block's token tuple.
+    Nodes are ref-counted while an admitted request uses them and evicted in
+    deterministic LRU order (a monotonic touch counter, never wall clock)
+    when the pool is full — only unpinned leaves are evictable, so a pinned
+    long prefix keeps its whole chain resident;
+  - **admission** does a longest-prefix match (`acquire`, which pins), a
+    jitted gather copies the matched blocks into the slot's cache rows
+    (`models/kv_cache.gather_block_rows`, traced inside the engine's cached
+    admission program), and only the uncached suffix is prefetched through
+    the bucketed prefill;
+  - **retire** donates the finished slot's prompt-region KV back to the pool
+    under the trie key (`insert` -> `models/kv_cache.scatter_block_rows`,
+    one jitted scatter however many blocks are new). Poisoned
+    (`FINISH_ERROR`) slots never donate.
+
+Because prefix blocks always sit at the same absolute positions (a prefix
+starts at token 0) the cached KV — position embeddings baked in — is valid
+for every request sharing those tokens, and because hits are *copies* into
+the slot's private cache the decode hot path is completely unchanged.
+Correctness bar: cached-vs-cold output is token-identical
+(tests/test_prefix_cache.py proves the matrix, including under eviction
+pressure and watchdog re-prefill).
+
+Shape discipline (the GSPMD lesson): matching, pinning, and eviction are
+host-side; the only device programs are the per-``(suffix_bucket,
+batch_bucket)`` cached admission (bounded like plain admission) and ONE
+donation scatter — block counts ride as data (out-of-range ids drop), never
+as shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.kv_cache import make_block_pool, scatter_block_rows
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Knobs for the engine's ``prefix_cache=`` argument.
+
+    ``block_tokens`` is the reuse granularity: a prefix match is always a
+    whole number of blocks, so smaller blocks reuse more of a shared prefix
+    but spend more trie nodes per prompt. Must be a power of two dividing
+    ``n_positions``. ``num_blocks`` sizes the device pool; None derives
+    ``2 * max_concurrency * (n_positions / block_tokens)`` — twice the KV
+    footprint of a full slot pool, enough that the working set of hot
+    prefixes survives slot churn before LRU pressure starts.
+    """
+
+    block_tokens: int = 16
+    num_blocks: int | None = None
+
+
+class _TrieNode:
+    """One cached block: a radix-trie edge keyed by the block's token tuple."""
+
+    __slots__ = ("key", "parent", "children", "block_id", "ref", "last_used")
+
+    def __init__(self, key: tuple[int, ...], parent: "_TrieNode | None",
+                 block_id: int):
+        self.key = key
+        self.parent = parent
+        self.children: dict[tuple[int, ...], _TrieNode] = {}
+        self.block_id = block_id
+        self.ref = 0
+        self.last_used = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixMatch:
+    """A pinned longest-prefix match: ``tokens`` cached tokens held in
+    ``block_ids`` pool blocks. Every node in ``nodes`` carries one reference
+    until `PrefixCache.release` (the engine releases on slot retirement)."""
+
+    tokens: int
+    block_ids: tuple[int, ...] = ()
+    nodes: tuple[Any, ...] = ()
+
+
+NO_MATCH = PrefixMatch(0)
+
+
+class PrefixCache:
+    """Block-granular prefix KV cache for `serving.ServingEngine`.
+
+    ``cache`` is the engine's slot-pool cache pytree (used as the layout
+    template — the pool mirrors its leaves block-wise, so fp32/bf16/int8
+    layouts all work unchanged). The trie and all policy live on the host;
+    the pool lives on device and is only touched by the engine's jitted
+    cached-admission gather and this class's jitted donation scatter.
+    """
+
+    def __init__(self, cache: Any, max_len: int, block_tokens: int = 16,
+                 num_blocks: int | None = None, metrics: Any = None):
+        block_tokens = int(block_tokens)
+        if block_tokens < 1 or block_tokens & (block_tokens - 1):
+            raise ValueError(f"block_tokens must be a power of two, got {block_tokens}")
+        if max_len % block_tokens:
+            raise ValueError(
+                f"block_tokens {block_tokens} must divide n_positions {max_len}"
+            )
+        self.block_tokens = block_tokens
+        self.max_len = int(max_len)
+        self.blocks_per_row = self.max_len // block_tokens
+        if num_blocks is None:
+            num_blocks = 2 * self.blocks_per_row * int(cache_batch_size(cache))
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.pool = make_block_pool(cache, self.num_blocks, block_tokens)
+        self.metrics = metrics
+        self._root = _TrieNode((), None, -1)
+        self._free: deque[int] = deque(range(self.num_blocks))
+        self._tick = 0
+        # donation scatter: ONE compiled program for any number of new blocks
+        # (skipped blocks ride as dropped out-of-range ids, not shapes)
+        self._scatter = jax.jit(scatter_block_rows, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ matching
+    def _walk(self, prompt: list[int]) -> list[_TrieNode]:
+        """Longest-prefix trie walk over full blocks, capped so at least one
+        prompt token is left for the suffix prefill (admission must run the
+        final prompt token through the model to sample the first output)."""
+        cap = (len(prompt) - 1) // self.block_tokens
+        node, path = self._root, []
+        while len(path) < cap:
+            lo = len(path) * self.block_tokens
+            child = node.children.get(tuple(prompt[lo:lo + self.block_tokens]))
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        return path
+
+    def match_len(self, prompt: list[int]) -> int:
+        """Cached-prefix length for ``prompt`` (no pinning — the scheduler's
+        suffix-bucketing probe). Multiple of ``block_tokens``, always
+        ``< len(prompt)``."""
+        return len(self._walk(prompt)) * self.block_tokens
+
+    def acquire(self, prompt: list[int]) -> PrefixMatch:
+        """Longest-prefix match that PINS every matched node (ref-count +1
+        each) so eviction cannot reclaim blocks an in-flight request is
+        copying from / logically depends on. Pair with `release`."""
+        path = self._walk(prompt)
+        for node in path:
+            node.ref += 1
+            self._touch(node)
+        return PrefixMatch(
+            tokens=len(path) * self.block_tokens,
+            block_ids=tuple(n.block_id for n in path),
+            nodes=tuple(path),
+        )
+
+    def trim(self, match: PrefixMatch, n_blocks: int) -> PrefixMatch:
+        """Shrink a pinned match to its first ``n_blocks`` blocks, releasing
+        the pins past the cut (the engine trims when a cached prefix plus the
+        suffix bucket would overrun ``n_positions``)."""
+        for node in match.nodes[n_blocks:]:
+            node.ref -= 1
+        return PrefixMatch(
+            tokens=n_blocks * self.block_tokens,
+            block_ids=match.block_ids[:n_blocks],
+            nodes=match.nodes[:n_blocks],
+        )
+
+    def release(self, match: PrefixMatch) -> None:
+        """Drop the pins taken by `acquire` (slot retirement)."""
+        for node in match.nodes:
+            node.ref -= 1
+
+    # ------------------------------------------------------------------ donation
+    def insert(self, prompt: list[int], cache: Any, slot: int) -> int:
+        """Donate a retired slot's prompt-region KV: every full block of
+        ``prompt`` not already in the trie gets a pool block (LRU-evicting
+        unpinned leaves when the free list is empty) and ONE jitted scatter
+        copies the new blocks out of slot row ``slot``. Returns how many
+        blocks were newly stored (0 = full dedup hit, no device work).
+
+        Donation stops at the first block it cannot place (an exhausted,
+        fully-pinned pool): a radix trie cannot reach block ``j+1`` without
+        block ``j``, so a partial prefix is still fully useful and nothing
+        past the gap could ever be matched.
+        """
+        n_blocks = min(len(prompt) // self.block_tokens, self.blocks_per_row)
+        dest = np.full(self.blocks_per_row, self.num_blocks, np.int32)
+        node, new = self._root, 0
+        for j in range(n_blocks):
+            key = tuple(prompt[j * self.block_tokens:(j + 1) * self.block_tokens])
+            child = node.children.get(key)
+            if child is None:
+                block_id = self._alloc()
+                if block_id is None:
+                    break
+                child = _TrieNode(key, node, block_id)
+                node.children[key] = child
+                dest[j] = block_id
+                new += 1
+            self._touch(child)
+            node = child
+        if new:
+            self.pool = self._scatter(
+                self.pool, cache, jnp.asarray(slot, jnp.int32), jnp.asarray(dest)
+            )
+            if self.metrics is not None:
+                self.metrics.prefix_blocks_donated.inc(new)
+        return new
+
+    # ------------------------------------------------------------------ eviction
+    def _alloc(self) -> int | None:
+        if self._free:
+            return self._free.popleft()
+        return self._evict_one()
+
+    def _evict_one(self) -> int | None:
+        """Reclaim the least-recently-used evictable block. Only unpinned
+        LEAVES qualify: an interior node backs every longer prefix below it,
+        and a pinned node is in use by an in-flight request. Deterministic —
+        ``last_used`` is a unique monotonic counter, so a replayed trace
+        evicts in exactly the same order."""
+        victim = None
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.children or node.ref > 0:
+                continue
+            if victim is None or node.last_used < victim.last_used:
+                victim = node
+        if victim is None:
+            return None
+        del victim.parent.children[victim.key]
+        if self.metrics is not None:
+            self.metrics.prefix_evictions.inc()
+        return victim.block_id
+
+    def _touch(self, node: _TrieNode) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    # ----------------------------------------------------------------- inspection
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently resident in the trie (eviction hands a reclaimed
+        block straight to its new tenant, so allocated == resident)."""
+        return self.num_blocks - len(self._free)
+
+    def node_count(self) -> int:
+        count, stack = 0, list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            count += 1
+            stack.extend(node.children.values())
+        return count
+
+
+def cache_batch_size(cache: Any) -> int:
+    """Leading (slot) dimension of a per-slot cache pytree."""
+    leaves = jax.tree_util.tree_leaves(cache)
+    return max(leaf.shape[0] for leaf in leaves)

@@ -48,6 +48,11 @@ class Request:
     wastes a slot). ``retries`` is stamped by the engine's step watchdog: a
     poisoned decode step re-prefills the request once from its prompt, a
     second poisoning retires it with `FINISH_ERROR`.
+
+    ``cache_prefix`` opts this request out of prefix KV reuse when False: its
+    prompt is always prefilled from token 0 and its KV is never donated to
+    the shared pool (`serving/prefix_cache.py` — opt out for privacy-scoped
+    prompts or A/B measurement; tokens are identical either way).
     """
 
     prompt: list[int]
@@ -56,6 +61,7 @@ class Request:
     arrival_time: float | None = None
     deadline_s: float | None = None
     retries: int = 0
+    cache_prefix: bool = True
 
 
 @dataclass

@@ -37,6 +37,13 @@ class FIFOScheduler:
         # the engine caps this at n_positions - 1 so every admitted request has
         # room for at least one generated token
         self.max_prompt_len = int(max_prompt_len or self.buckets[-1])
+        # prefix-aware bucketing hook (set by the engine when its prefix cache
+        # is enabled): maps a request to the prompt-token count admission will
+        # actually PREFILL — the uncached suffix. Grouping by suffix bucket
+        # keeps one batched prefill per (suffix_bucket, batch_bucket) pair, so
+        # the compile cache stays bounded even though cached prefixes shrink
+        # prompts by arbitrary block multiples.
+        self.prefill_len_fn = None
         self._queue: deque[Request] = deque()
 
     def bucket_for(self, prompt_len: int) -> int:
@@ -71,18 +78,28 @@ class FIFOScheduler:
         """Pop the oldest queued request (FIFO), or None when idle."""
         return self._queue.popleft() if self._queue else None
 
+    def prefill_bucket_for(self, request: Request) -> int:
+        """The bucket admission will pad this request's PREFILL to: its full
+        prompt bucket, or — with a prefix cache probing via
+        ``prefill_len_fn`` — the bucket of just the uncached suffix."""
+        n = len(request.prompt)
+        if self.prefill_len_fn is not None:
+            n = max(1, min(n, int(self.prefill_len_fn(request))))
+        return self.bucket_for(n)
+
     def peek_run(self, max_n: int) -> int:
         """Length (up to ``max_n``) of the contiguous run of queued requests at
-        the FRONT that share the head's prompt bucket — the group one batched
-        admission call can prefill together. Only the front run counts:
-        skipping past a differently-bucketed head to batch later arrivals
-        would break FIFO fairness."""
+        the FRONT that share the head's PREFILL bucket (the suffix bucket when
+        a prefix cache is probing) — the group one batched admission call can
+        prefill together. Only the front run counts: skipping past a
+        differently-bucketed head to batch later arrivals would break FIFO
+        fairness."""
         if not self._queue or max_n <= 0:
             return 0
-        head_bucket = self.bucket_for(len(self._queue[0].prompt))
+        head_bucket = self.prefill_bucket_for(self._queue[0])
         n = 0
         for r in self._queue:
-            if n >= max_n or self.bucket_for(len(r.prompt)) != head_bucket:
+            if n >= max_n or self.prefill_bucket_for(r) != head_bucket:
                 break
             n += 1
         return n

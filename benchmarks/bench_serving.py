@@ -22,13 +22,27 @@ tokens requests actually asked for. Prints ONE machine-readable JSON line
 with vs_baseline = pipelined_tps / lockstep_tps (>1.0 = continuous batching
 wins); detail carries engine_depth1/engine_pipelined/lockstep breakdowns.
 
+``BENCH_SERVE_WORKLOAD=prefix`` switches to the shared-system-prompt workload
+instead: every request repeats one long system prefix with a short unique
+tail (plus a configurable fraction of cold, unique-prefix requests), and the
+engine runs twice on the SAME trace — prefix cache off, then on
+(`serving/prefix_cache.py`). The JSON line then carries metric
+"serving_prefix_cache" with value = prefill-token reduction (fraction of
+prompt prefill skipped via reuse; the PR-4 acceptance bar is >= 0.30),
+vs_baseline = tokens_per_sec(on) / tokens_per_sec(off), and detail splits
+TTFT p50/p99 by cache hit vs miss.
+
 Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_REQUESTS     trace length (default 32)
   BENCH_SERVE_CONCURRENCY  engine slots == lockstep batch size (default 8)
-  BENCH_SERVE_RATE         Poisson arrival rate, req/s (default 200: saturating)
+  BENCH_SERVE_RATE         Poisson arrival rate, req/s (default 200: saturating;
+                           prefix mode defaults to 8 — unsaturated, see above)
   BENCH_SERVE_SEED         trace rng seed (default 0)
   BENCH_SERVE_DEPTH        pipelined run's pipeline_depth (default 2)
   BENCH_SERVE_ADMIT        admit_batch for both engine runs (default 4)
+  BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system prompt)
+  BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64)
+  BENCH_SERVE_MISS_FRAC    prefix-mode fraction of cold-prefix requests (0.25)
 
 Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
 """
@@ -129,7 +143,114 @@ def _run_lockstep(module, params, trace, concurrency) -> tuple[float, float, dic
     return tokens / dt, dt, {"decoded_tokens": decoded, "requested_tokens": tokens}
 
 
+def _prefix_trace(n: int, rate: float, seed: int, vocab: int, prefix_len: int,
+                  miss_frac: float) -> list[Request]:
+    """Shared-system-prompt workload: every hot request is one common
+    ``prefix_len``-token prefix plus a 4..12-token unique tail; a
+    ``miss_frac`` fraction carries a unique cold prefix instead (so hit and
+    miss TTFT populations both exist in one measured window)."""
+    r = np.random.default_rng(seed)
+    shared = r.integers(0, vocab, (prefix_len,)).astype(np.int32).tolist()
+    t, reqs = 0.0, []
+    for i in range(n):
+        t += float(r.exponential(1.0 / rate))
+        tail = r.integers(0, vocab, (int(r.integers(4, 13)),)).astype(np.int32).tolist()
+        if r.random() < miss_frac:
+            head = r.integers(0, vocab, (prefix_len,)).astype(np.int32).tolist()
+        else:
+            head = shared
+        reqs.append(Request(
+            prompt=head + tail,
+            params=SamplingParams(max_new_tokens=int(r.integers(8, 17))),
+            arrival_time=t,
+        ))
+    return reqs
+
+
+def main_prefix() -> None:
+    from accelerate_tpu.serving import PrefixCacheConfig, ServingMetrics
+
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 32)
+    concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 8)
+    # unsaturated on purpose (vs the ragged workload's 200/s): at saturation
+    # TTFT is queue wait, which buries the prefill-latency delta prefix reuse
+    # exists to shrink — the hit/miss split is only meaningful off-saturation
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 8.0))
+    seed = _env_int("BENCH_SERVE_SEED", 0)
+    depth = _env_int("BENCH_SERVE_DEPTH", 2)
+    admit = _env_int("BENCH_SERVE_ADMIT", 4)
+    prefix_len = _env_int("BENCH_SERVE_PREFIX_LEN", 64)
+    miss_frac = float(os.environ.get("BENCH_SERVE_MISS_FRAC", 0.25))
+
+    cfg = GPT2Config(vocab_size=2048, n_positions=128, n_embd=512, n_layer=6,
+                     n_head=8, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    buckets = (16, prefix_len + 16)  # hit suffixes vs full/cold prompts
+    trace = _prefix_trace(n_requests, rate, seed, cfg.vocab_size, prefix_len,
+                          miss_frac)
+    # warm trace: same shared prefix, DIFFERENT cold prefixes and tails — it
+    # compiles every (suffix_bucket, batch_bucket) program and warms the trie
+    # with the shared prefix, without pre-caching the timed trace's cold heads
+    warm = _prefix_trace(n_requests, rate, seed + 1, cfg.vocab_size, prefix_len,
+                         miss_frac)
+
+    def timed(prefix_cache):
+        engine = ServingEngine(
+            module, params, max_concurrency=concurrency,
+            prompt_buckets=buckets, max_queue=len(trace) + 1,
+            pipeline_depth=depth, admit_batch=admit, prefix_cache=prefix_cache,
+        )
+        _run_engine(engine, warm)
+        engine.metrics = ServingMetrics()
+        if engine.prefix_cache is not None:
+            engine.prefix_cache.metrics = engine.metrics
+        tps, dt, detail = _run_engine(engine, trace)
+        return tps, dt, detail, engine.metrics
+
+    off_tps, off_dt, off_detail, off_m = timed(False)
+    on_tps, on_dt, on_detail, on_m = timed(PrefixCacheConfig())
+    skipped = off_m.prefill_tokens.value - on_m.prefill_tokens.value
+    reduction = skipped / max(off_m.prefill_tokens.value, 1)
+
+    print(json.dumps({
+        "metric": "serving_prefix_cache",
+        "value": round(reduction, 4),
+        "unit": "prefill_tokens_skipped_frac",
+        "vs_baseline": round(on_tps / off_tps, 3),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "prefix_len": prefix_len,
+            "miss_frac": miss_frac,
+            "pipeline_depth": depth,
+            "admit_batch": admit,
+            "prefill_tokens_cache_off": off_m.prefill_tokens.value,
+            "prefill_tokens_cache_on": on_m.prefill_tokens.value,
+            "prefill_tokens_skipped": skipped,
+            "prefix_hits": on_m.prefix_hits.value,
+            "prefix_misses": on_m.prefix_misses.value,
+            "prefix_tokens_reused": on_m.prefix_tokens_reused.value,
+            "prefix_blocks_donated": on_m.prefix_blocks_donated.value,
+            "prefix_evictions": on_m.prefix_evictions.value,
+            "ttft_hit_p50_s": round(on_m.ttft_hit_s.quantile(0.5), 5),
+            "ttft_hit_p99_s": round(on_m.ttft_hit_s.quantile(0.99), 5),
+            "ttft_miss_p50_s": round(on_m.ttft_miss_s.quantile(0.5), 5),
+            "ttft_miss_p99_s": round(on_m.ttft_miss_s.quantile(0.99), 5),
+            "ttft_p50_cache_off_s": round(off_m.ttft_s.quantile(0.5), 5),
+            "cache_on": {"tokens_per_sec": round(on_tps, 2),
+                         "wall_s": round(on_dt, 3), **on_detail},
+            "cache_off": {"tokens_per_sec": round(off_tps, 2),
+                          "wall_s": round(off_dt, 3), **off_detail},
+        },
+    }), flush=True)
+
+
 def main() -> None:
+    if os.environ.get("BENCH_SERVE_WORKLOAD", "ragged") == "prefix":
+        main_prefix()
+        return
     n_requests = _env_int("BENCH_SERVE_REQUESTS", 32)
     concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 8)
     rate = float(os.environ.get("BENCH_SERVE_RATE", 200.0))

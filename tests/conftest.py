@@ -24,6 +24,11 @@ def pytest_configure(config):
         "serving: continuous-batching serving engine tests — the standalone "
         "serving suite is `pytest -m serving`",
     )
+    config.addinivalue_line(
+        "markers",
+        "prefix_cache: prefix KV-cache reuse tests (serving/prefix_cache.py) "
+        "— run standalone with `pytest -m prefix_cache`",
+    )
 
 
 @pytest.fixture

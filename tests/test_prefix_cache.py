@@ -1,0 +1,316 @@
+"""Prefix KV-cache reuse (`serving/prefix_cache.py`): token identity against
+solo `generate` across the cache on/off x pipeline_depth x admit_batch matrix,
+ref-count pinning, deterministic LRU eviction, donation policy, and the block
+gather/scatter primitives.
+
+The load-bearing contract is the same as the serving suite's, strengthened: a
+request whose prompt prefix is served FROM THE CACHE must emit exactly the
+tokens the cold engine — and a solo ``generate`` — would, including under
+eviction pressure and watchdog re-prefill.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+flax_nn = pytest.importorskip("flax.linen")
+
+pytestmark = [pytest.mark.serving, pytest.mark.prefix_cache]
+
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.models.kv_cache import (
+    gather_block_rows,
+    make_block_pool,
+    scatter_block_rows,
+)
+from accelerate_tpu.reliability import FaultSpec
+from accelerate_tpu.serving import (
+    FINISH_ERROR,
+    PrefixCache,
+    PrefixCacheConfig,
+    Request,
+    SamplingParams,
+    ServingEngine,
+)
+
+BT = 16  # GPT2Config.tiny has n_positions=128 -> 8 blocks per row at 16
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    return module, params
+
+
+def _solo(module, params, prompt, n, temperature=0.0, top_k=None, seed=0):
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = generate(module, params, ids, max_new_tokens=n,
+                   temperature=temperature, top_k=top_k, rng=jax.random.key(seed))
+    return np.asarray(out)[0].tolist()
+
+
+def _shared_prefix_requests(n=6, prefix_len=37, n_new=8):
+    """Requests sharing a long common prefix (2 full blocks at BT=16) with
+    short distinct tails, mixing greedy and sampled rows."""
+    r = np.random.default_rng(0)
+    prefix = r.integers(0, 256, (prefix_len,)).astype(np.int32).tolist()
+    reqs = []
+    for i in range(n):
+        tail = [100 + i, 7, (3 * i) % 256]
+        temp = 0.0 if i % 2 == 0 else 0.8
+        reqs.append(Request(
+            prompt=prefix + tail,
+            params=SamplingParams(max_new_tokens=n_new, temperature=temp,
+                                  top_k=None if i % 3 else 5, seed=i),
+        ))
+    return reqs
+
+
+# ------------------------------------------------------------- unit: primitives
+def _fake_cache(b=2, max_len=16, width=3):
+    """A minimal per-slot cache pytree with distinctive values (the prefix
+    cache only needs the treedef + leading [b, max_len] layout)."""
+    key = jnp.arange(b * max_len * width, dtype=jnp.float32).reshape(b, max_len, width)
+    return {"cached_key": key, "cached_value": key * 0.5 + 1.0,
+            "cache_index": jnp.zeros((b,), jnp.int32)}
+
+
+def test_block_gather_scatter_roundtrip():
+    """scatter_block_rows then gather_block_rows reproduces the donated slot
+    row region bit-for-bit, drops out-of-range dest ids, and stamps the
+    resume index into cache_index leaves."""
+    cache = _fake_cache(b=2, max_len=16)
+    pool = make_block_pool(cache, num_blocks=5, block_tokens=4)
+    assert pool["cached_key"].shape == (5, 4, 3)
+    assert pool["cache_index"].shape == (5,)
+    # donate slot 1's first two 4-token blocks into pool blocks 3 and 0;
+    # entries == num_blocks (5) must be dropped, not clamped
+    dest = jnp.asarray([3, 0, 5, 5], jnp.int32)
+    pool = scatter_block_rows(pool, cache, jnp.int32(1), dest)
+    row = np.asarray(cache["cached_key"][1])
+    np.testing.assert_array_equal(np.asarray(pool["cached_key"][3]), row[0:4])
+    np.testing.assert_array_equal(np.asarray(pool["cached_key"][0]), row[4:8])
+    assert not np.asarray(pool["cached_key"][4]).any()  # dropped, untouched
+    got = gather_block_rows(pool, jnp.asarray([[3, 0, 3, 3]], jnp.int32),
+                            jnp.asarray([8], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got["cached_key"][0, :8]), row[:8])
+    np.testing.assert_array_equal(
+        np.asarray(got["cached_value"][0, :8]), np.asarray(cache["cached_value"][1, :8])
+    )
+    np.testing.assert_array_equal(np.asarray(got["cache_index"]), [8])
+
+
+def test_trie_refcount_pins_blocks_against_eviction():
+    """Pinned nodes (in-flight sharers) are never evicted; donation that
+    cannot place a block stops without corrupting the trie; release/trim
+    drop the pins."""
+    cache = _fake_cache(b=1, max_len=16)
+    pc = PrefixCache(cache, max_len=16, block_tokens=4, num_blocks=2)
+    a = list(range(10))  # 2 full blocks + partial
+    assert pc.insert(a, cache, 0) == 2
+    m1, m2 = pc.acquire(a), pc.acquire(a)  # two in-flight sharers
+    assert m1.tokens == m2.tokens == 8 and m1.block_ids == m2.block_ids
+    assert all(n.ref == 2 for n in m1.nodes)
+    # pool is full and fully pinned: a competing donation places nothing
+    assert pc.insert(list(range(50, 60)), cache, 0) == 0
+    assert pc.match_len(a) == 8  # trie untouched by the failed donation
+    pc.release(m1)
+    m2 = pc.trim(m2, 1)  # trim releases the pins past the cut
+    assert m2.tokens == 4 and m1.nodes[1].ref == 0
+    pc.release(m2)
+    assert all(n.ref == 0 for n in m1.nodes)
+    # everything unpinned: the competing donation can now evict its way in
+    assert pc.insert(list(range(50, 60)), cache, 0) == 2
+    assert pc.match_len(list(range(50, 60))) == 8 and pc.match_len(a) == 0
+
+
+def test_lru_eviction_is_deterministic_and_leaf_only():
+    """Under a full pool, eviction removes the least-recently-TOUCHED unpinned
+    leaf (monotonic tick, no wall clock) — interior nodes survive until their
+    subtree is gone, so a refreshed prefix keeps its chain."""
+    cache = _fake_cache(b=1, max_len=16)
+    pc = PrefixCache(cache, max_len=16, block_tokens=4, num_blocks=3)
+    a = list(range(9))  # blocks A1, A2
+    b = list(range(100, 105))  # block B1
+    assert pc.insert(a, cache, 0) == 2
+    assert pc.insert(b, cache, 0) == 1
+    pc.release(pc.acquire(a))  # refresh A's whole chain: B is now LRU
+    c = list(range(200, 209))  # needs 2 blocks -> 2 evictions
+    assert pc.insert(c, cache, 0) == 2
+    assert pc.metrics is None  # unit-level: no metrics bag attached
+    # B went first (oldest leaf), then A's leaf A2 (A1 is interior until A2
+    # dies, then still fresher than nothing else); A keeps one block
+    assert pc.match_len(b) == 0
+    assert pc.match_len(a) == 4
+    assert pc.match_len(c) == 8
+    assert pc.node_count() == 3 and pc.cached_blocks == 3
+
+
+def test_prefix_cache_validates_config():
+    cache = _fake_cache(b=1, max_len=16)
+    with pytest.raises(ValueError):
+        PrefixCache(cache, max_len=16, block_tokens=6)  # not a power of two
+    with pytest.raises(ValueError):
+        PrefixCache(cache, max_len=10, block_tokens=4)  # does not divide
+    with pytest.raises(ValueError):
+        PrefixCacheConfig(block_tokens=0) and PrefixCache(
+            cache, max_len=16, block_tokens=4, num_blocks=0
+        )
+
+
+def test_match_capped_below_full_prompt():
+    """A fully-cached prompt still leaves >= 1 token for the suffix prefill
+    (admission samples the first output from the last prompt token)."""
+    cache = _fake_cache(b=1, max_len=16)
+    pc = PrefixCache(cache, max_len=16, block_tokens=4, num_blocks=4)
+    a = list(range(8))  # exactly 2 blocks
+    pc.insert(a, cache, 0)
+    assert pc.match_len(a) == 4  # NOT 8: the last block is held back
+    assert pc.match_len(a + [99]) == 8  # a longer prompt may use both
+
+
+# ------------------------------------------------------------ engine: parity
+@pytest.mark.parametrize("cache_on", [False, True])
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("admit", [1, 4])
+def test_parity_matrix_cached_vs_solo(model, cache_on, depth, admit):
+    """The full matrix: cache on/off x pipeline_depth {1,2} x admit_batch
+    {1,4} — every request token-identical to its solo generate, so prefix
+    reuse (gather + suffix prefill + donation) never perturbs a stream."""
+    module, params = model
+    reqs = _shared_prefix_requests()
+    refs = [_solo(module, params, r.prompt, r.params.max_new_tokens,
+                  temperature=r.params.temperature, top_k=r.params.top_k,
+                  seed=r.params.seed) for r in reqs]
+    engine = ServingEngine(
+        module, params, max_concurrency=3, prompt_buckets=(8, 16, 64),
+        pipeline_depth=depth, admit_batch=admit,
+        prefix_cache=PrefixCacheConfig(block_tokens=BT) if cache_on else False,
+    )
+    outs = engine.run(reqs)
+    for out, ref in zip(sorted(outs, key=lambda o: o.request_id), refs):
+        assert out.tokens == ref
+    if cache_on:
+        m = engine.metrics
+        assert m.prefix_hits.value > 0 and m.prefix_tokens_reused.value > 0
+        assert m.prefix_blocks_donated.value > 0
+        # the reused tokens were NOT prefilled
+        total_prompt = sum(len(r.prompt) for r in reqs)
+        assert m.prefill_tokens.value <= total_prompt - m.prefix_tokens_reused.value
+        assert m.ttft_hit_s.count == m.prefix_hits.value
+        assert m.ttft_miss_s.count == m.prefix_misses.value
+
+
+def test_parity_under_eviction_pressure(model):
+    """A pool far too small for the working set keeps evicting hot blocks;
+    outputs must stay token-identical regardless (eviction only loses reuse,
+    never correctness)."""
+    module, params = model
+    r = np.random.default_rng(7)
+    reqs = []
+    for i in range(6):
+        prefix = r.integers(0, 256, (35,)).astype(np.int32).tolist()
+        reqs.append(Request(prompt=prefix + [i], params=SamplingParams(max_new_tokens=6)))
+    reqs.extend(Request(prompt=list(q.prompt), params=q.params) for q in reqs[:3])
+    engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(8, 64),
+        prefix_cache=PrefixCacheConfig(block_tokens=BT, num_blocks=2),
+    )
+    outs = engine.run(reqs)
+    for out, req in zip(sorted(outs, key=lambda o: o.request_id), reqs):
+        assert out.tokens == _solo(module, params, req.prompt, 6)
+    assert engine.metrics.prefix_evictions.value > 0
+    assert engine.prefix_cache.cached_blocks <= 2
+
+
+def test_two_inflight_sharers_pin_the_same_blocks(model):
+    """Two concurrent requests admitted off the same cached prefix hold the
+    same blocks pinned (ref == 2) until retirement releases them."""
+    module, params = model
+    reqs = _shared_prefix_requests(n=3, n_new=16)
+    engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(8, 64),
+        admit_batch=2, prefix_cache=PrefixCacheConfig(block_tokens=BT),
+    )
+    # warm the trie: serve one request to completion so it donates
+    engine.run([reqs[0]])
+    assert engine.metrics.prefix_blocks_donated.value == 2
+    for q in reqs[1:]:
+        assert engine.submit(q).accepted
+    engine.step()  # admits both sharers off the cached prefix
+    pinned = [m for m in engine._slot_match if m is not None]
+    assert len(pinned) == 2
+    assert pinned[0].block_ids == pinned[1].block_ids
+    assert all(n.ref == 2 for n in pinned[0].nodes)
+    while engine.has_work:
+        engine.step()
+    assert all(m is None for m in engine._slot_match)
+    assert all(n.ref == 0 for n in pinned[0].nodes)
+
+
+def test_cache_prefix_opt_out(model):
+    """cache_prefix=False requests neither read nor feed the cache — and stay
+    token-identical (the opt-out is a policy knob, not a behavior change)."""
+    module, params = model
+    reqs = _shared_prefix_requests(n=4)
+    for q in reqs:
+        q.cache_prefix = False
+    engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(8, 64),
+        prefix_cache=PrefixCacheConfig(block_tokens=BT),
+    )
+    outs = engine.run(reqs)
+    for out, req in zip(sorted(outs, key=lambda o: o.request_id), reqs):
+        assert out.tokens == _solo(
+            module, params, req.prompt, req.params.max_new_tokens,
+            temperature=req.params.temperature, top_k=req.params.top_k,
+            seed=req.params.seed,
+        )
+    m = engine.metrics
+    assert m.prefix_hits.value == 0 and m.prefix_misses.value == 0
+    assert m.prefix_blocks_donated.value == 0
+    assert engine.prefix_cache.cached_blocks == 0
+
+
+# ------------------------------------------------- engine: faults and donation
+@pytest.mark.fault
+def test_finish_error_slot_never_donates(model, fault_injection):
+    """A twice-poisoned request retires FINISH_ERROR; its (garbage) KV must
+    not be donated to the shared pool."""
+    module, params = model
+    prompt = np.random.default_rng(3).integers(0, 256, (36,)).tolist()
+    fault_injection(FaultSpec.poison(at_steps=(1, 4), slots=(0,)))
+    engine = ServingEngine(
+        module, params, max_concurrency=1, prompt_buckets=(8, 64),
+        prefix_cache=PrefixCacheConfig(block_tokens=BT),
+    )
+    out = engine.run([Request(prompt=prompt, params=SamplingParams(max_new_tokens=16))])[0]
+    assert out.finish_reason == FINISH_ERROR
+    assert engine.metrics.prefix_blocks_donated.value == 0
+    assert engine.prefix_cache.cached_blocks == 0
+    assert engine.prefix_cache.node_count() == 0
+
+
+@pytest.mark.fault
+def test_watchdog_reprefill_parity_with_cache_hits(model, fault_injection):
+    """A poisoned slot's re-prefill may now HIT the cache (its own donation or
+    a sibling's) — the replay must still be token-identical to solo."""
+    module, params = model
+    reqs = _shared_prefix_requests(n=3, n_new=8)
+    fault_injection(FaultSpec.poison(at_steps=(3,), slots=(1,)))
+    engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(8, 64),
+        prefix_cache=PrefixCacheConfig(block_tokens=BT),
+    )
+    outs = engine.run(reqs)
+    assert engine.metrics.requests_retried.value == 1
+    for out, req in zip(sorted(outs, key=lambda o: o.request_id), reqs):
+        assert out.tokens == _solo(
+            module, params, req.prompt, req.params.max_new_tokens,
+            temperature=req.params.temperature, top_k=req.params.top_k,
+            seed=req.params.seed,
+        )

@@ -340,6 +340,22 @@ def test_histogram_reservoir_stays_bounded():
     assert s["count"] == 10_000 and s["p50"] <= s["p90"] <= s["p99"]
 
 
+def test_histogram_empty_state_exports_zero():
+    """An unobserved histogram must report 0.0 min/max, not the inf/-inf
+    running sentinels — trackers (JSONL, W&B) reject non-finite scalars, and
+    ttft_hit_s/ttft_miss_s are legitimately empty whenever a workload is
+    all-hit or all-miss."""
+    from accelerate_tpu.serving.metrics import Histogram, ServingMetrics
+
+    h = Histogram()
+    assert h.min == 0.0 and h.max == 0.0 and h.mean == 0.0
+    h.observe(3.5)
+    assert h.min == 3.5 and h.max == 3.5
+    # a fresh metrics bag (every histogram empty) snapshots all-finite
+    snap = ServingMetrics().snapshot()
+    assert all(np.isfinite(v) for v in snap.values())
+
+
 # ---------------------------------------------------- watchdog / fault handling
 @pytest.mark.fault
 def test_watchdog_quarantines_only_the_poisoned_slot(model, fault_injection):

@@ -14,6 +14,16 @@ replays bit-identically):
   - a tight queue-wait deadline on every ``CHAOS_DEADLINE_EVERY``-th request
     (exercising REJECT_DEADLINE queue expiry under load).
 
+The replay runs with the PREFIX CACHE enabled by default (``CHAOS_PREFIX=1``,
+`serving/prefix_cache.py`) over a deliberately tiny block pool
+(``CHAOS_PREFIX_BLOCKS``, default 6) so LRU eviction fires mid-chaos, and
+every third request duplicates an earlier prompt so donation -> hit reuse
+actually happens under quarantine churn. Beyond zero-lost, the harness then
+asserts ZERO PARITY DRIFT: every request that finished ``eos``/``length`` —
+cached, evicted, or watchdog-re-prefilled — must match its solo
+``generate`` token-for-token (``CHAOS_VERIFY_PARITY=0`` skips the solo
+reference pass when you only want the lost-request invariant).
+
 Prints ONE JSON line: {"metric": "chaos_serve_lost_requests", "value": 0, ...}.
 
 Run: JAX_PLATFORMS=cpu python tools/chaos_serve.py
@@ -28,6 +38,10 @@ Env knobs:
   CHAOS_DEPTH           engine pipeline_depth (default 2: the replay must prove
                         the zero-lost guarantee survives LAGGED retirement —
                         set 1 to bisect a failure against synchronous dispatch)
+  CHAOS_PREFIX          1 (default) serves through the prefix cache; 0 = off
+  CHAOS_PREFIX_BLOCKS   prefix pool size in blocks (default 6: forces eviction)
+  CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
+                        generate; 0 skips the reference pass
 """
 
 from __future__ import annotations
@@ -57,21 +71,46 @@ def run(
     module=None,
     params=None,
     pipeline_depth: int = 2,
+    prefix_cache: bool = True,
+    prefix_blocks: int = 6,
+    verify_parity: bool = True,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
-    return the summary dict (importable — tests/test_reliability.py runs it)."""
+    (with ``verify_parity``) zero token drift against solo generate; return
+    the summary dict (importable — tests/test_reliability.py runs it)."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
+    from accelerate_tpu.models.generation import generate
     from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
     from accelerate_tpu.reliability import FaultInjector, FaultSpec, inject
-    from accelerate_tpu.serving import Request, ServingEngine
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        PrefixCacheConfig,
+        Request,
+        ServingEngine,
+    )
 
     if module is None:
         cfg = GPT2Config.tiny(dtype=jnp.float32)
         module = GPT2LMHead(cfg)
         params = module.init_params(jax.random.key(0))
     trace = _trace(n_requests, rate, seed, int(module.config.vocab_size))
+    # every third request duplicates an earlier block-sized prompt, so
+    # retire-time donation -> prefix hits actually occur under the chaos (the
+    # base trace's prompts are all-distinct random tokens and would never
+    # share blocks). The source sits >= concurrency+1 requests back: any
+    # closer and it would typically still be decoding — not yet donated —
+    # when the duplicate is admitted at a saturating arrival rate.
+    for j in range(2, len(trace), 3):
+        donors = [k for k in range(j - concurrency - 1)
+                  if len(trace[k].prompt) > 16]
+        if donors:
+            trace[j] = Request(prompt=list(trace[donors[-1]].prompt),
+                               params=trace[j].params,
+                               arrival_time=trace[j].arrival_time)
 
     specs = []
     if poison_every:
@@ -80,12 +119,18 @@ def run(
             slots=(0,),
         ))
     injector = FaultInjector(seed=seed, specs=specs)
-    engine = ServingEngine(module, params, max_concurrency=concurrency,
-                           prompt_buckets=BUCKETS, max_queue=n_requests + 1,
-                           pipeline_depth=pipeline_depth)
+    engine = ServingEngine(
+        module, params, max_concurrency=concurrency,
+        prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+        pipeline_depth=pipeline_depth,
+        prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
+                      if prefix_cache else False),
+    )
 
     submitted: dict[int, str] = {}
     terminal: dict[int, str] = {}
+    outputs: dict[int, list[int]] = {}
+    req_by_id: dict[int, Request] = {}
     t0 = time.perf_counter()
     pending = list(trace)
     i = 0
@@ -100,16 +145,41 @@ def run(
                     deadline_s=deadline_s if tight else None,
                 ))
                 submitted[result.request_id] = "deadline" if tight else "plain"
+                req_by_id[result.request_id] = src
                 if not result.accepted:
                     terminal[result.request_id] = f"rejected:{result.reason}"
                 i += 1
             for out in engine.step():
                 terminal[out.request_id] = out.finish_reason
+                outputs[out.request_id] = out.tokens
             if not engine.has_work and pending:
                 time.sleep(max(0.0, pending[0].arrival_time - (time.perf_counter() - t0)))
 
     lost = sorted(set(submitted) - set(terminal))
     assert not lost, f"lost requests (accepted but no terminal output): {lost}"
+
+    # parity drift: every cleanly finished request — whether its prefill came
+    # cold, from cached blocks, after an eviction, or via a watchdog
+    # re-prefill — must match the solo lockstep reference token-for-token.
+    # Runs OUTSIDE the injector context: the reference must stay unpoisoned.
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, reason in terminal.items():
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            src = req_by_id[rid]
+            ids = jnp.asarray(np.asarray(src.prompt, np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=src.params.max_new_tokens,
+                temperature=src.params.temperature, top_k=src.params.top_k,
+                rng=jax.random.key(src.params.seed),
+            )
+            checked += 1
+            if outputs[rid] != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, f"parity drift vs solo generate: requests {drift}"
+
     reasons: dict[str, int] = {}
     for reason in terminal.values():
         reasons[reason] = reasons.get(reason, 0) + 1
@@ -124,6 +194,14 @@ def run(
             "poisson_rate": rate,
             "seed": seed,
             "pipeline_depth": pipeline_depth,
+            "prefix_cache": bool(prefix_cache),
+            "prefix_blocks": prefix_blocks if prefix_cache else 0,
+            "prefix_hits": m.prefix_hits.value,
+            "prefix_misses": m.prefix_misses.value,
+            "prefix_evictions": m.prefix_evictions.value,
+            "prefix_blocks_donated": m.prefix_blocks_donated.value,
+            "parity_checked": checked,
+            "parity_drift": len(drift),
             "terminal_reasons": reasons,
             "steps": m.steps.value,
             "steps_poisoned": m.steps_poisoned.value,
@@ -144,6 +222,9 @@ def main() -> None:
         deadline_every=_env_int("CHAOS_DEADLINE_EVERY", 6),
         deadline_s=float(os.environ.get("CHAOS_DEADLINE_S", 0.0)),
         pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+        prefix_cache=bool(_env_int("CHAOS_PREFIX", 1)),
+        prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
+        verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
     )
     print(json.dumps(summary), flush=True)
 
