@@ -57,6 +57,11 @@ class GPT2Config:
     # continuous-batching slot pool — serving/engine.py). position_offset may
     # then be a [b] vector too.
     kv_cache_per_slot: bool = False
+    # mesh layout for the per-slot cache (a parallel.sharding.KVCacheSharding,
+    # hashable so the frozen config stays hashable): heads sharded on the
+    # serving mesh's model axis, slots optionally on data. None everywhere but
+    # the mesh-sharded serving engine.
+    kv_cache_sharding: Any = None
     # fp8 projections (reference TE convert_model role): a DelayedScalingRecipe
     # switches every block Dense to ops/fp8.Fp8Dense (delayed-scaling fp8
     # matmuls; scaling state rides the mutable fp8_meta collection)
@@ -116,6 +121,7 @@ class SelfAttention(nn.Module):
             k_all, v_all, idx, is_init = decode_cache_update(
                 self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype,
                 per_slot=cfg.kv_cache_per_slot, write_mask=cache_write_mask,
+                sharding=cfg.kv_cache_sharding,
             )
             if is_init:
                 if cfg.kv_cache_per_slot:

@@ -28,6 +28,7 @@ def decode_cache_update(
     kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized
     per_slot: bool = False,  # [b]-vector write index (continuous batching)
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze (per_slot)
+    sharding: Any = None,  # parallel.sharding.KVCacheSharding: in-jit mesh layout
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Create/update the module's decode cache and return
     ``(k_all, v_all, write_index, is_init)``.
@@ -50,6 +51,12 @@ def decode_cache_update(
     dispatch the host's retirement lags the device by up to ``pipeline_depth``
     steps, and a finished slot must not keep mutating its cache while it waits
     to be recycled.
+
+    ``sharding`` (a `parallel.sharding.KVCacheSharding`, per_slot path) pins
+    the updated buffers to the serving mesh layout with in-jit sharding
+    constraints — heads on the ``model`` axis, slots optionally on ``data`` —
+    so XLA's propagation cannot drift the donated pool cache's layout between
+    steps. ``None`` (the default, and all of training) changes nothing.
     """
     if kv_cache_dtype is not None and np.dtype(kv_cache_dtype) != np.dtype("int8"):
         # fail fast with the cause named — an arbitrary dtype would surface as
@@ -127,12 +134,22 @@ def decode_cache_update(
             cached_v.value = row4(cached_v.value, vq, idx)
             k_scale.value = row3(k_scale.value, ks, idx)
             v_scale.value = row3(v_scale.value, vs, idx)
+            if sharding is not None:
+                cached_k.value = jax.lax.with_sharding_constraint(cached_k.value, sharding.kv)
+                cached_v.value = jax.lax.with_sharding_constraint(cached_v.value, sharding.kv)
+                k_scale.value = jax.lax.with_sharding_constraint(k_scale.value, sharding.scale)
+                v_scale.value = jax.lax.with_sharding_constraint(v_scale.value, sharding.scale)
             k_all = _dq(cached_k.value, k_scale.value, k.dtype)
             v_all = _dq(cached_v.value, v_scale.value, v.dtype)
         else:
             cached_k.value = row4(cached_k.value, k, idx)
             cached_v.value = row4(cached_v.value, v, idx)
+            if sharding is not None:
+                cached_k.value = jax.lax.with_sharding_constraint(cached_k.value, sharding.kv)
+                cached_v.value = jax.lax.with_sharding_constraint(cached_v.value, sharding.kv)
             k_all, v_all = cached_k.value, cached_v.value
+        if sharding is not None:
+            next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
     elif quant:
         kq, ks = _q(k)
         vq, vs = _q(v)
@@ -154,7 +171,40 @@ def _is_index_leaf(path) -> bool:
     return getattr(path[-1], "key", None) == "cache_index"
 
 
-def make_block_pool(cache: Any, num_blocks: int, block_tokens: int) -> Any:
+def make_cache(module: Any, batch: int, shardings: Any = None) -> Any:
+    """Allocate the zeroed ``[batch, n_positions, ...]`` per-slot decode cache
+    pytree for ``module`` (the serving engine's slot pool) without running a
+    real forward: shapes come from `jax.eval_shape` over ``module.init``, so
+    no throwaway init compute touches the device.
+
+    ``shardings`` is an optional congruent pytree of NamedShardings
+    (`parallel.sharding.infer_cache_shardings`): each leaf is then allocated
+    directly into its mesh placement — a model-sharded pool never materializes
+    unsharded on one device, which is the whole point of serving models that
+    do not fit a single chip.
+    """
+    shapes = jax.eval_shape(
+        lambda: module.init(
+            jax.random.key(0), jnp.zeros((batch, 1), jnp.int32), decode=True
+        )["cache"]
+    )
+    if shardings is None:
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return jax.tree.map(
+        lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+        shapes, shardings,
+    )
+
+
+def _constrain_tree(tree: Any, shardings: Any) -> Any:
+    """Apply a congruent pytree of NamedShardings as in-jit constraints."""
+    if shardings is None:
+        return tree
+    return jax.tree.map(jax.lax.with_sharding_constraint, tree, shardings)
+
+
+def make_block_pool(cache: Any, num_blocks: int, block_tokens: int,
+                    shardings: Any = None) -> Any:
     """Allocate the device-resident block pool for prefix KV reuse
     (`serving/prefix_cache.py`): a pytree mirroring a per-slot cache, but with
     every KV leaf carved into ``[num_blocks, block_tokens, ...]`` fixed-size
@@ -164,6 +214,11 @@ def make_block_pool(cache: Any, num_blocks: int, block_tokens: int) -> Any:
     write cursor — block occupancy lives in the host-side radix trie); they
     exist only so the pool shares the cache's treedef and one ``tree_map``
     drives every gather/scatter.
+
+    ``shardings`` (a congruent pytree of NamedShardings,
+    `parallel.sharding.infer_block_pool_shardings`) allocates each block leaf
+    straight into its mesh placement — heads sharded on the model axis, blocks
+    replicated across replicas so any replica can reuse any cached prefix.
     """
 
     def alloc(path, leaf):
@@ -171,13 +226,17 @@ def make_block_pool(cache: Any, num_blocks: int, block_tokens: int) -> Any:
             return jnp.zeros((num_blocks,), leaf.dtype)
         return jnp.zeros((num_blocks, block_tokens) + leaf.shape[2:], leaf.dtype)
 
-    return jax.tree_util.tree_map_with_path(alloc, cache)
+    pool = jax.tree_util.tree_map_with_path(alloc, cache)
+    if shardings is not None:
+        pool = jax.tree.map(jax.device_put, pool, shardings)
+    return pool
 
 
 def gather_block_rows(
     block_pool: Any,  # [num_blocks, block_tokens, ...] pool pytree
     block_tables: jax.Array,  # [nb, blocks_per_row] int32 pool block ids
     cache_index: jax.Array,  # [nb] int32 resume index (the cached prefix length)
+    shardings: Any = None,  # congruent NamedShardings for the assembled rows
 ) -> Any:
     """Assemble ``nb`` cache rows from pool blocks in ONE gather per leaf: row
     ``i`` is ``block_tables[i]``'s blocks concatenated along the token axis
@@ -195,7 +254,9 @@ def gather_block_rows(
         rows = leaf[block_tables]  # [nb, blocks_per_row, block_tokens, ...]
         return rows.reshape((rows.shape[0], rows.shape[1] * rows.shape[2]) + rows.shape[3:])
 
-    return jax.tree_util.tree_map_with_path(gather, block_pool)
+    return _constrain_tree(
+        jax.tree_util.tree_map_with_path(gather, block_pool), shardings
+    )
 
 
 def scatter_block_rows(
@@ -203,6 +264,7 @@ def scatter_block_rows(
     cache: Any,  # the [B, n_positions, ...] slot-pool cache pytree
     slot: jax.Array,  # scalar int32 slot row to donate from
     dest_blocks: jax.Array,  # [n_positions // block_tokens] int32 pool ids; >= num_blocks drops
+    shardings: Any = None,  # congruent NamedShardings keeping the pool's layout
 ) -> Any:
     """Donate one slot row's KV into pool blocks in ONE scatter per leaf (the
     prefix cache's retire-time donation). ``dest_blocks[j]`` is where the
@@ -218,7 +280,9 @@ def scatter_block_rows(
         blocks = row.reshape((n_blocks, row.shape[0] // n_blocks) + row.shape[1:])
         return pool_leaf.at[dest_blocks].set(blocks, mode="drop")
 
-    return jax.tree_util.tree_map_with_path(scatter, block_pool, cache)
+    return _constrain_tree(
+        jax.tree_util.tree_map_with_path(scatter, block_pool, cache), shardings
+    )
 
 
 def scatter_cache_slots(
@@ -226,6 +290,7 @@ def scatter_cache_slots(
     new_cache: Any,  # an [nb, ...] freshly prefilled cache pytree
     slots: jax.Array,  # [nb] int32 distinct pool rows to write
     cache_index: jax.Array,  # [nb] int32 per-row resume index (unpadded length)
+    shardings: Any = None,  # congruent NamedShardings keeping the pool's layout
 ) -> Any:
     """Scatter an ``nb``-row prefill cache into pool rows ``slots`` in ONE
     jitted op per leaf (the serving engine's batched admission: `pipeline
@@ -242,4 +307,6 @@ def scatter_cache_slots(
             return pool_leaf.at[slots].set(cache_index.astype(pool_leaf.dtype))
         return pool_leaf.at[slots].set(new_leaf.astype(pool_leaf.dtype))
 
-    return jax.tree_util.tree_map_with_path(insert, pool_cache, new_cache)
+    return _constrain_tree(
+        jax.tree_util.tree_map_with_path(insert, pool_cache, new_cache), shardings
+    )

@@ -99,6 +99,38 @@ def build_mesh(
     return Mesh(dev_array, MESH_AXIS_NAMES)
 
 
+def serving_mesh(
+    data: int = 1,
+    model: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """The serving engine's ``(data, model)`` mesh: ``data`` replicas each
+    holding a ``model``-way tensor-parallel shard of the weights and KV pool
+    (`serving/engine.py` ``mesh=``). The model axis IS the standard ``tensor``
+    axis, so `gpt2_sharding_rules()` and the training-path TP annotations apply
+    unchanged; the remaining axes are degree 1.
+
+    Unlike `build_mesh` this takes the FIRST ``data * model`` devices instead
+    of requiring an exact cover — a (2, 2) serving mesh on an 8-device host is
+    a normal single-host-multi-device test topology.
+    """
+    data, model = int(data), int(model)
+    if data < 1 or model < 1:
+        raise ValueError(f"mesh degrees must be >= 1, got data={data} model={model}")
+    if devices is None:
+        devices = jax.devices()
+    need = data * model
+    if len(devices) < need:
+        raise ValueError(
+            f"serving mesh ({data}, {model}) needs {need} devices, "
+            f"only {len(devices)} available"
+        )
+    sizes = {"data": data, "tensor": model}
+    shape = tuple(sizes.get(name, 1) for name in MESH_AXIS_NAMES)
+    dev_array = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(dev_array, MESH_AXIS_NAMES)
+
+
 def mesh_axis_size(mesh: Mesh, *names: str) -> int:
     """Product of the sizes of the given axes."""
     return math.prod(mesh.shape[n] for n in names)

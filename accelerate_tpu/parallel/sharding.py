@@ -20,6 +20,7 @@ complexity.
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 from typing import Any
@@ -109,6 +110,36 @@ def _fsdp_spec(shape: tuple[int, ...], existing: PartitionSpec | None, fsdp_size
     return PartitionSpec(*parts)
 
 
+def _sanitize_spec(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+    """Make ``spec`` valid for a leaf of ``shape`` on ``mesh``, degrading to
+    replication instead of erroring.
+
+    Three repair steps, each dropping only the offending piece:
+      - axis names the mesh does not carry are removed (a serving mesh without
+        an ``fsdp`` axis treats an fsdp reference as degree 1 — no sharding);
+      - a spec longer than the leaf's rank collapses to fully replicated (the
+        scalar/1-D fallback: GPT-2 layernorm scales/biases matched by a 2-D
+        rule must come out replicated, not raise in ``device_put``);
+      - a dim whose size is not divisible by its axes' total degree is
+        replicated (uneven param shards would silently pad).
+    """
+    if len(spec) > len(shape):
+        return PartitionSpec(*([None] * len(shape)))
+    parts: list = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            parts.append(None)
+            continue
+        names = tuple(n for n in (entry if isinstance(entry, tuple) else (entry,))
+                      if n in mesh.shape)
+        degree = math.prod(mesh.shape[n] for n in names)
+        if not names or (degree > 1 and shape[dim] % degree != 0):
+            parts.append(None)
+        else:
+            parts.append(names if len(names) > 1 else names[0])
+    return PartitionSpec(*parts)
+
+
 def infer_param_shardings(
     params: Any,
     mesh: Mesh,
@@ -120,6 +151,11 @@ def infer_param_shardings(
     TP rules apply first (by path); the ``fsdp`` axis is then folded into whatever
     dims remain free. With ``shard_params_on_fsdp=False`` the fsdp axis only shards
     optimizer state (ZeRO-1 semantics, reference `DeepSpeedPlugin.zero_stage==1`).
+
+    Leaves no rule fits — or that a rule fits *invalidly* (spec rank above the
+    leaf's, axes the mesh lacks, indivisible dims) — come out REPLICATED rather
+    than raising: scalar and 1-D leaves like layernorm scales/biases must never
+    block sharding the tree they ride in (see `_sanitize_spec`).
     """
     fsdp_size = mesh.shape.get("fsdp", 1)
     names = param_path_names(params)
@@ -127,11 +163,13 @@ def infer_param_shardings(
     def _spec(name: str, leaf: Any) -> NamedSharding:
         base = rules.match(name) if rules is not None else None
         shape = tuple(getattr(leaf, "shape", ()))
+        if base is not None:
+            base = _sanitize_spec(base, shape, mesh)
         if shard_params_on_fsdp:
             spec = _fsdp_spec(shape, base, fsdp_size)
         else:
             spec = base if base is not None else PartitionSpec()
-        return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, _sanitize_spec(spec, shape, mesh))
 
     return jax.tree.map(_spec, names, params)
 
@@ -155,3 +193,85 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 def constrain(x: Any, mesh: Mesh, spec: PartitionSpec) -> Any:
     """with_sharding_constraint helper usable inside jitted code."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# --------------------------------------------------------------------------- KV
+# Serving-side sharding rules: the engine's slot-pool KV cache and the prefix
+# block pool are pytrees of a known leaf zoo (models/kv_cache.py):
+#   cached_key / cached_value  [slots, max_len, kv_heads, head_dim]
+#   key_scale  / value_scale   [slots, max_len, kv_heads]        (int8 storage)
+#   cache_index                [slots]
+# Tensor parallelism shards the HEAD dim (attention is embarrassingly parallel
+# over heads — the collectives stay in the proj/down matmuls, exactly where the
+# training-mesh rules already put them); data parallelism shards the SLOT dim
+# so replicas decode disjoint slot ranges. Block pools shard heads only — a
+# block is one shared prefix, readable by every replica.
+
+
+@dataclass(frozen=True)
+class KVCacheSharding:
+    """The three NamedShardings a per-slot decode cache needs (hashable, so it
+    can ride inside a frozen model config — `GPT2Config.kv_cache_sharding` —
+    down to `models/kv_cache.decode_cache_update`'s in-jit constraints)."""
+
+    kv: NamedSharding  # [slots, max_len, kv_heads, head_dim] buffers
+    scale: NamedSharding  # [slots, max_len, kv_heads] int8 absmax scales
+    index: NamedSharding  # [slots] write cursor
+
+
+def _is_cache_index(path) -> bool:
+    return getattr(path[-1], "key", getattr(path[-1], "name", None)) == "cache_index"
+
+
+def kv_cache_sharding(
+    mesh: Mesh,
+    *,
+    slots: int | None = None,
+    batch_axes: tuple[str, ...] = ("data",),
+    head_axis: str = "tensor",
+) -> KVCacheSharding:
+    """Build the `KVCacheSharding` for a slot-pool cache on ``mesh``.
+
+    The slot dim is sharded over ``batch_axes`` only when ``slots`` divides
+    their total degree (pass ``slots=None`` to force replication of the slot
+    dim — the admission prefill's fresh rows use the head sharding alone).
+    """
+    batch_axes = tuple(n for n in batch_axes if mesh.shape.get(n, 1) > 1)
+    dsize = math.prod(mesh.shape[n] for n in batch_axes) if batch_axes else 1
+    row = batch_axes if (slots is not None and dsize > 1 and slots % dsize == 0) else None
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    return KVCacheSharding(
+        kv=NamedSharding(mesh, P(row, None, head, None)),
+        scale=NamedSharding(mesh, P(row, None, head)),
+        index=NamedSharding(mesh, P(row)),
+    )
+
+
+def infer_cache_shardings(cache: Any, sharding: KVCacheSharding) -> Any:
+    """Pytree of NamedShardings congruent with a slot-pool cache pytree (or its
+    `jax.eval_shape` ShapeDtypeStructs) — the engine's jit in/out_shardings for
+    every donated cache argument."""
+
+    def pick(path, leaf):
+        if _is_cache_index(path):
+            return sharding.index
+        return sharding.kv if getattr(leaf, "ndim", len(leaf.shape)) == 4 else sharding.scale
+
+    return jax.tree_util.tree_map_with_path(pick, cache)
+
+
+def infer_block_pool_shardings(pool: Any, mesh: Mesh, *, head_axis: str = "tensor") -> Any:
+    """NamedShardings for a prefix block pool: heads sharded like the slot
+    cache, blocks replicated across the data axis (any replica may gather any
+    cached prefix block — prefix reuse must not depend on which replica's slot
+    donated it)."""
+    head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+
+    def pick(path, leaf):
+        if _is_cache_index(path):
+            return NamedSharding(mesh, P(None))
+        ndim = getattr(leaf, "ndim", len(leaf.shape))
+        return NamedSharding(mesh, P(None, None, head, None) if ndim == 4
+                             else P(None, None, head))
+
+    return jax.tree_util.tree_map_with_path(pick, pool)

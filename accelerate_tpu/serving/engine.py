@@ -59,8 +59,18 @@ from typing import Any, Iterable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..models.kv_cache import gather_block_rows, scatter_cache_slots
+from ..models.gpt2 import gpt2_sharding_rules
+from ..models.kv_cache import gather_block_rows, make_cache, scatter_cache_slots
+from ..parallel.mesh import ParallelismConfig, mesh_axis_size, serving_mesh
+from ..parallel.sharding import (
+    infer_block_pool_shardings,
+    infer_cache_shardings,
+    infer_param_shardings,
+    kv_cache_sharding,
+    shard_params,
+)
 from ..reliability.faults import ALL_SLOTS, active_injector
 from .metrics import ServingMetrics
 from .prefix_cache import NO_MATCH, PrefixCache, PrefixCacheConfig, PrefixMatch
@@ -131,6 +141,18 @@ class ServingEngine:
     same-bucket queued requests one jitted prefill admits (batch buckets are
     the powers of two up to it, so compiles stay bounded).
 
+    ``mesh`` shards the whole engine over a ``(data, model)`` device mesh
+    (a `jax.sharding.Mesh`, a `ParallelismConfig`, or a ``(data, model)``
+    tuple): params by the Megatron-style TP rules, the KV pools on heads
+    along the model axis (which must divide ``n_head``), and — when
+    ``max_concurrency`` divides the data degree — the slot dim across
+    replicas, which then decode disjoint slot ranges. Token streams are
+    bit-identical to ``mesh=None`` (tests/test_serving_sharded.py proves the
+    matrix); the scheduler, pipelining, and all host-side bookkeeping are
+    mesh-oblivious. ``collective_probe_every=N`` times a tiny blocking
+    all-reduce every N steps into ``metrics.collective_s`` (benches only —
+    the block serializes the dispatch pipeline).
+
     Typical loop::
 
         engine = ServingEngine(module, params, max_concurrency=8)
@@ -157,6 +179,9 @@ class ServingEngine:
         tracker: Any = None,
         metrics_log_every: int = 0,
         metrics: ServingMetrics | None = None,
+        mesh: Any = None,
+        param_rules: Any = None,
+        collective_probe_every: int = 0,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -165,14 +190,82 @@ class ServingEngine:
                 "the serving engine needs the per-slot cache variant "
                 "(models/kv_cache.py) — GPT2LMHead supports it."
             )
-        if not cfg.kv_cache_per_slot:
-            module = type(module)(dataclasses.replace(cfg, kv_cache_per_slot=True))
-        self.module = module
-        self.params = params
-        self.max_len = int(module.config.n_positions)
         self.max_concurrency = int(max_concurrency)
         if self.max_concurrency < 1:
             raise ValueError(f"max_concurrency must be >= 1, got {max_concurrency}")
+        # mesh-sharded serving (docs/serving.md "Sharded serving"): ``mesh`` is
+        # a Mesh, a ParallelismConfig, or a (data, model) tuple. The model axis
+        # is the standard ``tensor`` axis — params shard by the training-path
+        # TP rules, the KV pools shard on heads, and (when divisible) the slot
+        # dim shards on ``data`` so replicas decode disjoint slot ranges. None
+        # keeps the single-device engine bit-for-bit: no sharding objects are
+        # created and every jit call below is exactly the unsharded one.
+        self.mesh = self._resolve_mesh(mesh)
+        self._mesh_data = self.mesh.shape.get("data", 1) if self.mesh is not None else 1
+        self._mesh_model = self.mesh.shape.get("tensor", 1) if self.mesh is not None else 1
+        self._slot_sharding = None    # KVCacheSharding for the [b, ...] slot pool
+        self._fresh_sharding = None   # head-only variant for admission's nb rows
+        self._cache_shardings = None  # NamedSharding pytrees congruent with ...
+        self._fresh_shardings = None  # ... the pool / fresh-rows / block-pool trees
+        self._pool_shardings = None
+        self._param_shardings = None
+        self._row_sharding = None     # [max_concurrency] per-slot state vectors
+        self._rep_sharding = None     # replicated scalars / [nb] admission inputs
+        if self.mesh is not None:
+            extra = {n: s for n, s in self.mesh.shape.items()
+                     if n not in ("data", "tensor") and s > 1}
+            if extra:
+                raise ValueError(
+                    f"the serving engine shards over (data, tensor) only; "
+                    f"mesh has extra non-trivial axes {extra}"
+                )
+            if self._mesh_model > 1 and cfg.n_head % self._mesh_model:
+                raise ValueError(
+                    f"model-axis degree {self._mesh_model} must divide "
+                    f"n_head={cfg.n_head} (attention is sharded over heads)"
+                )
+            self._slot_sharding = kv_cache_sharding(
+                self.mesh, slots=self.max_concurrency
+            )
+            self._fresh_sharding = kv_cache_sharding(self.mesh, slots=None)
+            self._row_sharding = self._slot_sharding.index
+            self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
+        # contiguous slot ranges per data replica (the slot dim shards like any
+        # leading batch dim: replica i owns rows [i*b/d, (i+1)*b/d)) — 1 when
+        # the slot dim is replicated (b % data != 0, or no mesh)
+        self._slot_replicas = (
+            self._mesh_data
+            if self._mesh_data > 1 and self.max_concurrency % self._mesh_data == 0
+            else 1
+        )
+        updates: dict[str, Any] = {}
+        if not cfg.kv_cache_per_slot:
+            updates["kv_cache_per_slot"] = True
+        if self.mesh is not None and hasattr(cfg, "kv_cache_sharding"):
+            updates["kv_cache_sharding"] = self._slot_sharding
+        if updates:
+            module = type(module)(dataclasses.replace(cfg, **updates))
+        self.module = module
+        # admission prefills a FRESH nb-row cache (nb = batch bucket, not b):
+        # its in-jit cache constraints must be the head-only layout — slot-dim
+        # specs applied to nb rows would be a different (often indivisible)
+        # partitioning, so admission traces a config carrying ``_fresh_sharding``
+        self._admit_module = module
+        if self.mesh is not None and hasattr(cfg, "kv_cache_sharding"):
+            self._admit_module = type(module)(dataclasses.replace(
+                module.config, kv_cache_sharding=self._fresh_sharding
+            ))
+        self.params = params
+        if self.mesh is not None:
+            # Megatron-style TP placement via the training-path rules (callers
+            # serving a non-GPT-2 model pass their own ``param_rules``);
+            # unmatched / scalar / 1-D leaves come out replicated
+            rules = param_rules if param_rules is not None else gpt2_sharding_rules()
+            self._param_shardings = infer_param_shardings(
+                params, self.mesh, rules=rules
+            )
+            self.params = shard_params(params, self._param_shardings)
+        self.max_len = int(module.config.n_positions)
         self.pipeline_depth = int(pipeline_depth)
         if self.pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
@@ -205,10 +298,21 @@ class ServingEngine:
         # ALL per-slot decode state — last token, position, sampling params,
         # rng chain (raw key data so slot updates are plain scatters), token
         # budget, and the finished mask. The decode loop never uploads any of
-        # it; only the jitted admission scatter writes slots.
-        self._cache = self.module.init(
-            jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True
-        )["cache"]
+        # it; only the jitted admission scatter writes slots. With a mesh the
+        # pool is allocated straight into its sharded placement (never
+        # materialized whole on one device) and the per-slot vectors follow
+        # the slot dim's layout.
+        if self.mesh is not None:
+            cache_shapes = jax.eval_shape(
+                lambda: self.module.init(
+                    jax.random.key(0), jnp.zeros((b, 1), jnp.int32), decode=True
+                )["cache"]
+            )
+            self._cache_shardings = infer_cache_shardings(
+                cache_shapes, self._slot_sharding
+            )
+            self._pool_shardings = infer_block_pool_shardings(cache_shapes, self.mesh)
+        self._cache = make_cache(self.module, b, shardings=self._cache_shardings)
         kd = jax.random.key_data(jax.random.key(0))
         self._rng_data = jnp.zeros((b,) + kd.shape, kd.dtype)
         self._d_tokens = jnp.zeros((b,), jnp.int32)
@@ -219,11 +323,26 @@ class ServingEngine:
         self._d_finished = jnp.ones((b,), bool)  # empty slots stay frozen
         self._d_eos = jnp.int32(-1 if eos_token_id is None else int(eos_token_id))
         self._no_poison = jnp.zeros((b,), bool)  # reused when no injector is active
+        if self.mesh is not None:
+            row = self._row_sharding
+            (self._rng_data, self._d_tokens, self._d_pos, self._d_temps,
+             self._d_topks, self._d_remaining, self._d_finished,
+             self._no_poison) = (
+                jax.device_put(a, row) for a in
+                (self._rng_data, self._d_tokens, self._d_pos, self._d_temps,
+                 self._d_topks, self._d_remaining, self._d_finished,
+                 self._no_poison)
+            )
+            self._d_eos = jax.device_put(self._d_eos, self._rep_sharding)
         self._fresh_shapes = jax.eval_shape(
             lambda: self.module.init(
                 jax.random.key(0), jnp.zeros((1, 1), jnp.int32), decode=True
             )["cache"]
         )
+        if self.mesh is not None:
+            self._fresh_shardings = infer_cache_shardings(
+                self._fresh_shapes, self._fresh_sharding
+            )
         # host-side slot bookkeeping: which request/output each slot serves,
         # and a per-slot generation counter that invalidates in-flight results
         # dispatched against a previous tenant
@@ -251,12 +370,79 @@ class ServingEngine:
             self.prefix_cache = PrefixCache(
                 self._cache, max_len=self.max_len,
                 block_tokens=pc_cfg.block_tokens, num_blocks=pc_cfg.num_blocks,
-                metrics=self.metrics,
+                metrics=self.metrics, shardings=self._pool_shardings,
             )
             self.scheduler.prefill_len_fn = self._prefill_len
             self._cached_admit_fn = self._build_cached_admit_fn()
         self._step_fn = self._build_step_fn()
         self._admit_fn = self._build_admit_fn()
+        # compile telemetry: every jitted serving program's first dispatch is
+        # timed (the python call blocks through trace+compile; execution stays
+        # async, so the first-call wall time is compile-dominated) under a
+        # ``kind[pb{N}b{M}]@mesh{D}x{T}`` key — see ServingMetrics.record_compile
+        self._compile_seen: set[str] = set()
+        # optional per-step collective probe: a tiny all-reduce over every
+        # non-trivial mesh axis, dispatched and BLOCKED right after the decode
+        # dispatch — an upper-bound measure of the mesh's per-step collective /
+        # straggler latency. Blocking serializes the dispatch pipeline, so it
+        # is opt-in (benches turn it on; production leaves it 0).
+        self.collective_probe_every = int(collective_probe_every)
+        self._probe_fn = None
+        self._probe_x = None
+        if self.mesh is not None and self.collective_probe_every > 0:
+            axes = tuple(n for n in ("data", "tensor") if self.mesh.shape[n] > 1)
+            if axes:
+                n = mesh_axis_size(self.mesh, *axes)
+                self._probe_x = jax.device_put(
+                    jnp.arange(n, dtype=jnp.float32),
+                    NamedSharding(self.mesh, PartitionSpec(axes)),
+                )
+                self._probe_fn = jax.jit(
+                    jnp.sum,
+                    out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+                )
+                # warm up now so the first observation is a collective, not a compile
+                jax.block_until_ready(self._probe_fn(self._probe_x))
+
+    # ------------------------------------------------------------------- mesh
+    @staticmethod
+    def _resolve_mesh(mesh: Any) -> Mesh | None:
+        """Accept a Mesh as-is, a `ParallelismConfig` (data/tensor degrees), or
+        a ``(data, model)`` tuple — the last two build a `serving_mesh` over
+        the first ``data * model`` devices. None stays None (unsharded)."""
+        if mesh is None or isinstance(mesh, Mesh):
+            return mesh
+        if isinstance(mesh, ParallelismConfig):
+            if max(mesh.fsdp_size, mesh.stage_size, mesh.sequence_size) > 1:
+                raise ValueError(
+                    "serving shards over (data, tensor) only; fsdp/stage/"
+                    "sequence degrees must be 1 in a serving ParallelismConfig"
+                )
+            data = 1 if mesh.data_parallel_size == -1 else mesh.data_parallel_size
+            return serving_mesh(data=data, model=mesh.tensor_size)
+        data, model = mesh
+        return serving_mesh(data=int(data), model=int(model))
+
+    @property
+    def mesh_shape(self) -> tuple[int, int]:
+        """(data, model) mesh degrees — (1, 1) when unsharded."""
+        return (self._mesh_data, self._mesh_model)
+
+    def _compile_key(self, kind: str, pb: int | None = None,
+                     bb: int | None = None) -> str:
+        tag = f"mesh{self._mesh_data}x{self._mesh_model}"
+        return f"{kind}@{tag}" if pb is None else f"{kind}[pb{pb}b{bb}]@{tag}"
+
+    def _dispatch(self, key: str, fn, *args):
+        """Call a jitted serving program, recording the first dispatch per key
+        as one compile (count + wall seconds) in the metrics."""
+        if key in self._compile_seen:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        self._compile_seen.add(key)
+        self.metrics.record_compile(key, time.perf_counter() - t0)
+        return out
 
     # ------------------------------------------------------------- jitted fns
     def _build_step_fn(self):
@@ -299,10 +485,22 @@ class ServingEngine:
             return (mutated["cache"], nxt, new_pos, new_remaining, new_finished,
                     jax.random.key_data(new_rngs), ok | finished)
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        if self.mesh is None:
+            return jax.jit(step_fn, donate_argnums=(0,))
+        # explicit shardings pin the hot loop's layout: the donated cache keeps
+        # its pool placement through every step (in == out, no resharding) and
+        # each [b] state vector rides the slot dim's layout
+        row, rep = self._row_sharding, self._rep_sharding
+        return jax.jit(
+            step_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          row, row, row, row, row, row, row, row, rep),
+            out_shardings=(self._cache_shardings, row, row, row, row, row, row),
+        )
 
     def _build_admit_fn(self):
-        module, fresh_shapes = self.module, self._fresh_shapes
+        module, fresh_shapes = self._admit_module, self._fresh_shapes
+        cache_shardings = self._cache_shardings
 
         def admit_fn(pool_cache, params, prompt_rows, slots, prompt_lens, temps,
                      top_ks, rng_batch, budgets, d_tokens, d_pos, d_temps,
@@ -330,7 +528,8 @@ class ServingEngine:
             new_rngs, keys = split[:, 0], split[:, 1]
             first = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
             new_pool = scatter_cache_slots(
-                pool_cache, mutated["cache"], slots, prompt_lens
+                pool_cache, mutated["cache"], slots, prompt_lens,
+                shardings=cache_shardings,
             )
             # first token rides out of the prefill itself; budget-1 tokens
             # remain for the decode loop (a 1-token budget or first-token EOS
@@ -347,7 +546,21 @@ class ServingEngine:
             return (new_pool, first, fin0, d_tokens, d_pos, d_temps, d_topks,
                     d_finished, d_remaining, rng_data)
 
-        return jax.jit(admit_fn, donate_argnums=(0,))
+        if self.mesh is None:
+            return jax.jit(admit_fn, donate_argnums=(0,))
+        # the [nb] admission inputs (padded prompts, lens, sampling params,
+        # seeds) are replicated — nb is small and the prefill's activations
+        # shard over heads via the param/TP rules; the [b] per-slot vectors
+        # keep the slot layout through the scatter
+        row, rep = self._row_sharding, self._rep_sharding
+        return jax.jit(
+            admit_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          rep, rep, rep, rep, rep, rep, rep,
+                          row, row, row, row, row, row, row, rep),
+            out_shardings=(self._cache_shardings, rep, rep,
+                           row, row, row, row, row, row, row),
+        )
 
     def _build_cached_admit_fn(self):
         """Admission with prefix reuse: gather each row's matched blocks out
@@ -357,7 +570,9 @@ class ServingEngine:
         like plain admission. One compile per ``(suffix_bucket, batch_bucket)``
         pair — the same bounded set as plain admission, because the scheduler
         re-buckets the SUFFIX (`FIFOScheduler.prefill_bucket_for`)."""
-        module = self.module
+        module = self._admit_module
+        cache_shardings = self._cache_shardings
+        fresh_shardings = self._fresh_shardings
 
         def admit_fn(pool_cache, params, block_pool, block_tables, cached_lens,
                      suffix_rows, suffix_lens, slots, temps, top_ks, rng_batch,
@@ -366,7 +581,8 @@ class ServingEngine:
             # rows assembled from pool blocks; table entries past a row's real
             # prefix fill positions the suffix write overwrites or the causal
             # mask (kv_pos <= cached_len + j) never lets a query read
-            fresh = gather_block_rows(block_pool, block_tables, cached_lens)
+            fresh = gather_block_rows(block_pool, block_tables, cached_lens,
+                                      shardings=fresh_shardings)
             logits, mutated = module.apply(
                 {"params": params, "cache": fresh}, suffix_rows, decode=True,
                 position_offset=cached_lens, mutable=["cache"],
@@ -383,7 +599,8 @@ class ServingEngine:
             # decode resumes from the FULL prompt end: cached prefix + suffix
             prompt_lens = cached_lens + suffix_lens
             new_pool = scatter_cache_slots(
-                pool_cache, mutated["cache"], slots, prompt_lens
+                pool_cache, mutated["cache"], slots, prompt_lens,
+                shardings=cache_shardings,
             )
             rem0 = budgets - 1
             fin0 = (rem0 <= 0) | ((eos_id >= 0) & (first == eos_id))
@@ -397,7 +614,20 @@ class ServingEngine:
             return (new_pool, first, fin0, d_tokens, d_pos, d_temps, d_topks,
                     d_finished, d_remaining, rng_data)
 
-        return jax.jit(admit_fn, donate_argnums=(0,))
+        if self.mesh is None:
+            return jax.jit(admit_fn, donate_argnums=(0,))
+        # block pool: heads sharded, blocks replicated across replicas (any
+        # replica gathers any cached prefix); everything else as plain admission
+        row, rep = self._row_sharding, self._rep_sharding
+        return jax.jit(
+            admit_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          self._pool_shardings,
+                          rep, rep, rep, rep, rep, rep, rep, rep, rep,
+                          row, row, row, row, row, row, row, rep),
+            out_shardings=(self._cache_shardings, rep, rep,
+                           row, row, row, row, row, row, row),
+        )
 
     def _prefill_len(self, request: Request) -> int:
         """Scheduler probe: prompt tokens admission would actually prefill for
@@ -455,11 +685,18 @@ class ServingEngine:
         n_active = self.active_slots
         self.metrics.observe_step(n_active, self.max_concurrency,
                                   self.scheduler.queue_depth)
+        if self._slot_replicas > 1:
+            per = self._active.reshape(self._slot_replicas, -1).sum(axis=1)
+            self.metrics.observe_replicas(
+                [int(x) for x in per],
+                self.max_concurrency // self._slot_replicas,
+            )
         self._step_count += 1
         if n_active:
             poison = self._poison_mask()
             (self._cache, nxt, self._d_pos, self._d_remaining, fin,
-             self._rng_data, ok) = self._step_fn(
+             self._rng_data, ok) = self._dispatch(
+                self._compile_key("step"), self._step_fn,
                 self._cache, self.params, self._d_tokens, self._d_pos,
                 self._d_temps, self._d_topks, self._rng_data, self._d_finished,
                 self._d_remaining,
@@ -472,6 +709,11 @@ class ServingEngine:
                 "step", (nxt, fin, ok),
                 tuple(range(self.max_concurrency)), tuple(self._slot_gen),
             ))
+            if (self._probe_fn is not None
+                    and self._step_count % self.collective_probe_every == 0):
+                t0 = time.perf_counter()
+                jax.block_until_ready(self._probe_fn(self._probe_x))
+                self.metrics.collective_s.observe(time.perf_counter() - t0)
             self._drain_to(self.pipeline_depth - 1, finished)
         if not self._active.any():
             # nothing left to overlap with — flush the lagged tail so every
@@ -769,7 +1011,8 @@ class ServingEngine:
             rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
         (self._cache, first, fin0, self._d_tokens, self._d_pos,
          self._d_temps, self._d_topks, self._d_finished,
-         self._d_remaining, self._rng_data) = self._admit_fn(
+         self._d_remaining, self._rng_data) = self._dispatch(
+            self._compile_key("admit", bucket, nb), self._admit_fn,
             self._cache, self.params, jnp.asarray(padded),
             jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
             jnp.asarray(temps), jnp.asarray(topks),
@@ -836,7 +1079,8 @@ class ServingEngine:
                 self.metrics.prefix_misses.inc()
         (self._cache, first, fin0, self._d_tokens, self._d_pos,
          self._d_temps, self._d_topks, self._d_finished,
-         self._d_remaining, self._rng_data) = self._cached_admit_fn(
+         self._d_remaining, self._rng_data) = self._dispatch(
+            self._compile_key("cached_admit", bucket, nb), self._cached_admit_fn,
             self._cache, self.params, pc.pool, jnp.asarray(tables),
             jnp.asarray(cached_lens), jnp.asarray(padded),
             jnp.asarray(suffix_lens),

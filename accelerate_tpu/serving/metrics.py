@@ -125,6 +125,24 @@ class ServingMetrics:
         self.prefix_blocks_donated = Counter()
         self.prefix_evictions = Counter()
         self.steps = Counter()
+        # mesh-sharded serving telemetry (engine ``mesh=``): per-step wall
+        # seconds of the cross-device sync probe (a tiny jitted all-reduce
+        # over every mesh axis, dispatched+blocked right after the decode
+        # dispatch — an upper-bound measure of per-step collective/straggler
+        # latency the mesh adds), and per-replica slot occupancy (one
+        # observation per data-axis replica per step, so imbalance between
+        # the disjoint slot ranges is visible as p50-vs-min spread)
+        self.collective_s = Histogram()
+        self.replica_occupancy = Histogram()
+        # compile telemetry: every first dispatch of a jitted serving program
+        # — decode step, plain/cached admission per (prompt_bucket,
+        # batch_bucket) — counts once, with its wall seconds recorded both in
+        # the histogram and per-key in ``compiles`` (key format
+        # ``kind[pb{N}b{M}]@mesh{D}x{T}``), so a bucket-explosion regression
+        # shows up as compile_count growth in bench output and chaos replays
+        self.compile_count = Counter()
+        self.compile_s = Histogram()
+        self.compiles: dict[str, float] = {}
         self.ttft_s = Histogram()
         # TTFT split by prefix-cache outcome: the hit histogram is the
         # headline number prefix reuse exists to shrink
@@ -148,6 +166,19 @@ class ServingMetrics:
         self.steps.inc()
         self.slot_occupancy.observe(active / capacity if capacity else 0.0)
         self.queue_depth.observe(queue_depth)
+
+    def observe_replicas(self, active_per_replica: list[int], capacity: int) -> None:
+        """Per-data-replica occupancy for one step (mesh-sharded slot pool:
+        replica ``i`` decodes its own contiguous slot range of ``capacity``)."""
+        for active in active_per_replica:
+            self.replica_occupancy.observe(active / capacity if capacity else 0.0)
+
+    def record_compile(self, key: str, seconds: float) -> None:
+        """First dispatch of a jitted serving program: one compile, keyed by
+        ``kind[pb{prompt_bucket}b{batch_bucket}]@mesh{data}x{model}``."""
+        self.compile_count.inc()
+        self.compile_s.observe(seconds)
+        self.compiles[key] = round(float(seconds), 4)
 
     def tokens_per_sec(self) -> float:
         if self._start is None:
@@ -174,8 +205,14 @@ class ServingMetrics:
             "serving/prefix_evictions": self.prefix_evictions.value,
             "serving/steps": self.steps.value,
             "serving/tokens_per_sec": self.tokens_per_sec(),
+            "serving/compile_count": self.compile_count.value,
         }
+        for key, seconds in self.compiles.items():
+            out[f"serving/compile/{key}"] = seconds
         for name, hist in (
+            ("collective_s", self.collective_s),
+            ("replica_occupancy", self.replica_occupancy),
+            ("compile_s", self.compile_s),
             ("ttft_s", self.ttft_s),
             ("ttft_hit_s", self.ttft_hit_s),
             ("ttft_miss_s", self.ttft_miss_s),

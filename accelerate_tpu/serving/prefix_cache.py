@@ -45,6 +45,7 @@ as shape.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from collections import deque
 from typing import Any
 
@@ -112,7 +113,8 @@ class PrefixCache:
     """
 
     def __init__(self, cache: Any, max_len: int, block_tokens: int = 16,
-                 num_blocks: int | None = None, metrics: Any = None):
+                 num_blocks: int | None = None, metrics: Any = None,
+                 shardings: Any = None):
         block_tokens = int(block_tokens)
         if block_tokens < 1 or block_tokens & (block_tokens - 1):
             raise ValueError(f"block_tokens must be a power of two, got {block_tokens}")
@@ -128,14 +130,23 @@ class PrefixCache:
         self.num_blocks = int(num_blocks)
         if self.num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
-        self.pool = make_block_pool(cache, self.num_blocks, block_tokens)
+        # ``shardings`` (a congruent NamedSharding pytree,
+        # `parallel.sharding.infer_block_pool_shardings`) allocates the pool
+        # straight into its mesh placement — heads on the model axis, blocks
+        # replicated so any replica reuses any prefix — and pins the donation
+        # scatter's output layout; None is the single-device pool, unchanged.
+        self.pool = make_block_pool(cache, self.num_blocks, block_tokens,
+                                    shardings=shardings)
         self.metrics = metrics
         self._root = _TrieNode((), None, -1)
         self._free: deque[int] = deque(range(self.num_blocks))
         self._tick = 0
         # donation scatter: ONE compiled program for any number of new blocks
         # (skipped blocks ride as dropped out-of-range ids, not shapes)
-        self._scatter = jax.jit(scatter_block_rows, donate_argnums=(0,))
+        self._scatter = jax.jit(
+            functools.partial(scatter_block_rows, shardings=shardings),
+            donate_argnums=(0,),
+        )
 
     # ------------------------------------------------------------------ matching
     def _walk(self, prompt: list[int]) -> list[_TrieNode]:

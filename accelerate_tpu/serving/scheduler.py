@@ -87,19 +87,32 @@ class FIFOScheduler:
             n = max(1, min(n, int(self.prefill_len_fn(request))))
         return self.bucket_for(n)
 
+    def _run_key(self, request: Request) -> tuple[int, bool]:
+        """The batched-admission grouping key: prefill bucket plus — when a
+        prefix cache is probing — the request's ``cache_prefix`` flag. A
+        cached and an uncached admission must never share one run: they take
+        DIFFERENT jitted programs (cached-gather vs plain prefill), so a mixed
+        group would both recompile per mix pattern and push opted-out
+        (privacy-scoped) prompts through the block-pool gather path."""
+        return (
+            self.prefill_bucket_for(request),
+            bool(request.cache_prefix) if self.prefill_len_fn is not None else False,
+        )
+
     def peek_run(self, max_n: int) -> int:
         """Length (up to ``max_n``) of the contiguous run of queued requests at
         the FRONT that share the head's PREFILL bucket (the suffix bucket when
-        a prefix cache is probing) — the group one batched admission call can
-        prefill together. Only the front run counts: skipping past a
-        differently-bucketed head to batch later arrivals would break FIFO
-        fairness."""
+        a prefix cache is probing) and — with the cache enabled — the head's
+        ``cache_prefix`` flag (see `_run_key`) — the group one batched
+        admission call can prefill together. Only the front run counts:
+        skipping past a differently-bucketed head to batch later arrivals
+        would break FIFO fairness."""
         if not self._queue or max_n <= 0:
             return 0
-        head_bucket = self.prefill_bucket_for(self._queue[0])
+        head_key = self._run_key(self._queue[0])
         n = 0
         for r in self._queue:
-            if n >= max_n or self.prefill_bucket_for(r) != head_bucket:
+            if n >= max_n or self._run_key(r) != head_key:
                 break
             n += 1
         return n

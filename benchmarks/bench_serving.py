@@ -43,6 +43,14 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system prompt)
   BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64)
   BENCH_SERVE_MISS_FRAC    prefix-mode fraction of cold-prefix requests (0.25)
+  BENCH_SERVE_MESH         mesh sweep instead: comma-separated (data, model)
+                           shapes, e.g. "1x1,2x1,1x2,2x2" — the ragged trace
+                           runs once per shape through `ServingEngine(mesh=...)`
+                           and each shape prints its own machine-readable row
+                           (tokens/sec, ITL p50/p99, per-step collective
+                           seconds, compile stats) before the final summary
+                           line; on CPU the needed virtual devices are forced
+  BENCH_SERVE_PROBE_EVERY  mesh mode: collective-probe period in steps (1)
 
 Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
 """
@@ -247,7 +255,106 @@ def main_prefix() -> None:
     }), flush=True)
 
 
+def main_mesh() -> None:
+    """Per-mesh-shape serving rows: the SAME ragged trace through
+    ``ServingEngine(mesh=(d, m))`` for every requested shape. One JSON row per
+    shape (tokens/sec, ITL p50/p99, per-step collective seconds from the
+    blocking all-reduce probe, compile count + per-program compile seconds),
+    then the one summary line `tools/bench_sweep.py` consumes (value = the
+    LAST shape's tokens/sec, vs_baseline = last / first — order the shapes so
+    the first is the 1x1 reference)."""
+    shapes: list[tuple[int, int]] = []
+    for tok in os.environ["BENCH_SERVE_MESH"].replace(" ", "").split(","):
+        if tok:
+            d, m = tok.lower().split("x")
+            shapes.append((int(d), int(m)))
+    if not shapes:
+        raise SystemExit("BENCH_SERVE_MESH set but no DxM shapes parsed")
+    if os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+        # mesh shapes need devices; on the host platform multiplex them BEFORE
+        # the backend initializes (the one audited defense — test_utils)
+        from accelerate_tpu.test_utils.platform import force_cpu_platform
+
+        force_cpu_platform(max(d * m for d, m in shapes))
+
+    from accelerate_tpu.serving import ServingMetrics
+
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 32)
+    concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 8)
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 200.0))
+    seed = _env_int("BENCH_SERVE_SEED", 0)
+    depth = _env_int("BENCH_SERVE_DEPTH", 2)
+    admit = _env_int("BENCH_SERVE_ADMIT", 4)
+    probe_every = _env_int("BENCH_SERVE_PROBE_EVERY", 1)
+
+    cfg = GPT2Config(vocab_size=2048, n_positions=128, n_embd=512, n_layer=6,
+                     n_head=8, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    trace = _trace(n_requests, rate, seed, cfg.vocab_size)
+
+    rows: dict[str, dict] = {}
+    for d, m in shapes:
+        engine = ServingEngine(
+            module, params, max_concurrency=concurrency,
+            prompt_buckets=BUCKETS, max_queue=len(trace) + 1,
+            pipeline_depth=depth, admit_batch=admit, mesh=(d, m),
+            collective_probe_every=probe_every,
+        )
+        _run_engine(engine, trace)  # warm pass: every compile lands here
+        compiles = dict(engine.metrics.compiles)
+        compile_count = engine.metrics.compile_count.value
+        engine.metrics = ServingMetrics()  # timed pass starts clean
+        tps, dt, detail = _run_engine(engine, trace)
+        mm = engine.metrics
+        steps = max(mm.steps.value, 1)
+        row = {
+            "row": "serving_mesh",
+            "mesh": f"{d}x{m}",
+            "tokens_per_sec": round(tps, 2),
+            "wall_s": round(dt, 3),
+            "itl_p50_s": detail["itl_p50_s"],
+            "itl_p99_s": detail["itl_p99_s"],
+            # per-step cost of the cross-device sync probe (upper bound on the
+            # mesh's per-step collective/straggler latency; 0.0 when probing
+            # is off or the mesh is 1x1 — no non-trivial axis to reduce over)
+            "collective_per_step_s": round(mm.collective_s.sum / steps, 6),
+            "collective_p50_s": round(mm.collective_s.quantile(0.5), 6),
+            "collective_p99_s": round(mm.collective_s.quantile(0.99), 6),
+            "compile_count": compile_count,
+            "compile_s": compiles,
+            "ttft_p50_s": detail["ttft_p50_s"],
+            "host_blocked_per_step_s": detail["host_blocked_per_step_s"],
+            "slot_occupancy_mean": detail["slot_occupancy_mean"],
+            "steps": detail["steps"],
+        }
+        rows[row["mesh"]] = row
+        print(json.dumps(row), flush=True)
+
+    first = rows[f"{shapes[0][0]}x{shapes[0][1]}"]["tokens_per_sec"]
+    last = rows[f"{shapes[-1][0]}x{shapes[-1][1]}"]
+    print(json.dumps({
+        "metric": "serving_mesh_tokens_per_sec",
+        "value": last["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": round(last["tokens_per_sec"] / max(first, 1e-9), 3),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "poisson_rate": rate,
+            "pipeline_depth": depth,
+            "admit_batch": admit,
+            "collective_probe_every": probe_every,
+            "shapes": rows,
+        },
+    }), flush=True)
+
+
 def main() -> None:
+    if os.environ.get("BENCH_SERVE_MESH"):
+        main_mesh()
+        return
     if os.environ.get("BENCH_SERVE_WORKLOAD", "ragged") == "prefix":
         main_prefix()
         return

@@ -29,6 +29,12 @@ def pytest_configure(config):
         "prefix_cache: prefix KV-cache reuse tests (serving/prefix_cache.py) "
         "— run standalone with `pytest -m prefix_cache`",
     )
+    config.addinivalue_line(
+        "markers",
+        "sharded: mesh-sharded serving tests (engine ``mesh=``; need >= 4 "
+        "host devices, provided by the force_cpu_platform(8) above — run "
+        "standalone with `pytest -m sharded`",
+    )
 
 
 @pytest.fixture

@@ -93,6 +93,32 @@ def test_scheduler_front_run_grouping():
     assert s.peek_run(4) == 0 and s.pop_run(2) == []  # empty queue
 
 
+def test_scheduler_run_splits_on_cache_prefix_flag():
+    """With a prefix cache probing (prefill_len_fn set), a run must never mix
+    cached and uncached admissions of the SAME bucket: they take different
+    jitted programs, and an opted-out prompt must not ride the block-pool
+    gather path."""
+    s = FIFOScheduler(prompt_buckets=(8, 16), max_queue=16)
+    s.prefill_len_fn = lambda r: len(r.prompt)  # cache probing, no hits
+    for n, flag in ((3, True), (5, True), (4, False), (6, True)):
+        assert s.submit(Request(prompt=[1] * n, cache_prefix=flag)).accepted
+    assert s.peek_run(4) == 2  # the opted-out request breaks the run
+    assert [len(r.prompt) for r in s.pop_run(2)] == [3, 5]
+    assert s.peek_run(4) == 1  # opted-out head runs alone...
+    assert [r.cache_prefix for r in s.pop_run(1)] == [False]
+    assert s.peek_run(4) == 1  # ...and the trailing cached request never
+    assert s.pop_run(1)[0].cache_prefix  # jumped past it (FIFO preserved)
+
+
+def test_scheduler_run_ignores_flag_without_prefix_cache():
+    """prefill_len_fn is None (cache off): cache_prefix is inert and grouping
+    stays bucket-only — the pre-prefix-cache behavior, bit for bit."""
+    s = FIFOScheduler(prompt_buckets=(8, 16), max_queue=16)
+    for n, flag in ((3, True), (5, False), (4, True)):
+        assert s.submit(Request(prompt=[1] * n, cache_prefix=flag)).accepted
+    assert s.peek_run(4) == 3  # one bucket-8 run despite mixed flags
+
+
 # ------------------------------------------------------- per-slot cache scatter
 class _CacheProbe(flax_nn.Module):
     max_len: int

@@ -42,6 +42,13 @@ Env knobs:
   CHAOS_PREFIX_BLOCKS   prefix pool size in blocks (default 6: forces eviction)
   CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
                         generate; 0 skips the reference pass
+  CHAOS_MESH            "DxM" (e.g. "2x2") replays through a mesh-sharded
+                        engine (`ServingEngine(mesh=(D, M))`): zero-lost AND
+                        zero-drift must hold with params tensor-parallel and
+                        the slot pool sharded — the watchdog quarantine,
+                        deadline expiry, and prefix reuse all ride over
+                        collectives. On CPU the D*M virtual devices are
+                        forced. Default: unsharded (single device)
 """
 
 from __future__ import annotations
@@ -74,6 +81,7 @@ def run(
     prefix_cache: bool = True,
     prefix_blocks: int = 6,
     verify_parity: bool = True,
+    mesh=None,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     (with ``verify_parity``) zero token drift against solo generate; return
@@ -125,6 +133,7 @@ def run(
         pipeline_depth=pipeline_depth,
         prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
                       if prefix_cache else False),
+        mesh=mesh,
     )
 
     submitted: dict[int, str] = {}
@@ -195,6 +204,9 @@ def run(
             "seed": seed,
             "pipeline_depth": pipeline_depth,
             "prefix_cache": bool(prefix_cache),
+            "mesh": f"{engine.mesh_shape[0]}x{engine.mesh_shape[1]}"
+                    if engine.mesh is not None else None,
+            "compile_count": m.compile_count.value,
             "prefix_blocks": prefix_blocks if prefix_cache else 0,
             "prefix_hits": m.prefix_hits.value,
             "prefix_misses": m.prefix_misses.value,
@@ -213,6 +225,16 @@ def run(
 
 
 def main() -> None:
+    mesh = None
+    if os.environ.get("CHAOS_MESH"):
+        d, m = os.environ["CHAOS_MESH"].lower().replace(" ", "").split("x")
+        mesh = (int(d), int(m))
+        if os.environ.get("JAX_PLATFORMS", "cpu").startswith("cpu"):
+            # must run before the backend initializes (the import of jax
+            # inside run() is what first touches it)
+            from accelerate_tpu.test_utils.platform import force_cpu_platform
+
+            force_cpu_platform(mesh[0] * mesh[1])
     summary = run(
         n_requests=_env_int("CHAOS_REQUESTS", 24),
         concurrency=_env_int("CHAOS_CONCURRENCY", 4),
@@ -225,6 +247,7 @@ def main() -> None:
         prefix_cache=bool(_env_int("CHAOS_PREFIX", 1)),
         prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
         verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+        mesh=mesh,
     )
     print(json.dumps(summary), flush=True)
 
