@@ -5,9 +5,11 @@ At the ROADMAP's production scale, preemptions and transient I/O failures are
 routine; this package supplies (a) the seeded `FaultInjector` that every
 recovery path is proven against in tests, (b) the `RetryPolicy` those paths
 share, and (c) the opt-in `PreemptionHandler` that lands a synchronous
-checkpoint inside a SIGTERM grace window. The serving watchdog and the
-checkpoint commit-marker / restore-fallback machinery consume these from
-`serving/engine.py` and `checkpointing.py`.
+checkpoint inside a SIGTERM grace window — plus its serving-aware variant
+`ServingPreemptionHandler`, which drains an engine inside the window and
+snapshots whatever could not finish for `ServingEngine.resume`. The serving
+watchdog and the checkpoint commit-marker / restore-fallback machinery
+consume these from `serving/engine.py` and `checkpointing.py`.
 """
 
 from .faults import (
@@ -24,7 +26,13 @@ from .faults import (
     fault_point,
     inject,
 )
-from .preemption import PreemptionHandler, install_preemption_handler
+from .preemption import (
+    SIGTERM_EXIT_CODE,
+    PreemptionHandler,
+    ServingPreemptionHandler,
+    install_preemption_handler,
+    install_serving_preemption_handler,
+)
 from .retry import RetryError, RetryPolicy
 
 __all__ = [
@@ -43,5 +51,8 @@ __all__ = [
     "RetryPolicy",
     "RetryError",
     "PreemptionHandler",
+    "ServingPreemptionHandler",
     "install_preemption_handler",
+    "install_serving_preemption_handler",
+    "SIGTERM_EXIT_CODE",
 ]

@@ -6,7 +6,8 @@ admission, per-request sampling params, FIFO queue with backpressure, and
 counters/histograms exported through the `tracking.py` tracker interface.
 """
 
-from .engine import ServingEngine
+from .engine import RecoveryReport, ServingEngine
+from .journal import JournalError, JournalScan, RequestJournal
 from .metrics import Counter, Histogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .request import (
@@ -27,6 +28,10 @@ from .scheduler import FIFOScheduler
 
 __all__ = [
     "ServingEngine",
+    "RecoveryReport",
+    "RequestJournal",
+    "JournalScan",
+    "JournalError",
     "PrefixCache",
     "PrefixCacheConfig",
     "ServingMetrics",

@@ -52,8 +52,11 @@ so a request served here emits the SAME tokens as a solo ``generate`` with
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any, Iterable
 
 import jax
@@ -72,6 +75,8 @@ from ..parallel.sharding import (
     shard_params,
 )
 from ..reliability.faults import ALL_SLOTS, active_injector
+from .journal import MAGIC as JOURNAL_MAGIC
+from .journal import JournalScan, RequestJournal, request_record
 from .metrics import ServingMetrics
 from .prefix_cache import NO_MATCH, PrefixCache, PrefixCacheConfig, PrefixMatch
 from .request import (
@@ -125,6 +130,55 @@ class _Inflight:
     arrays: tuple
     slots: tuple[int, ...]
     gens: tuple[int, ...]
+
+
+# engine snapshot file format tag (docs/reliability.md "Serving recovery"):
+# a JSON document written atomically (tmp + fsync + rename) by
+# `ServingEngine.snapshot`, restorable by `ServingEngine.resume`
+SNAPSHOT_FORMAT = "accelerate_tpu/serving-snapshot-v1"
+
+
+# Process-level cache of the unsharded engines' jitted programs. An unsharded
+# engine's step/admit closures depend only on the module (every per-engine
+# quantity — slot count, buckets, sampling state — enters as a traced argument
+# and specializes per shape under the one jit wrapper), so a fresh engine over
+# the same module — a crash-recovery resume, an A/B replica, a test fixture —
+# reuses every existing trace instead of recompiling it. Entries pin a strong
+# module ref so the id() key can never be reused by a new object. Sharded
+# engines keep per-instance jits: their shardings genuinely differ.
+_SHARED_JITS: dict[int, tuple[Any, dict[str, Any]]] = {}
+
+
+def _shared_jit(module: Any, kind: str, build):
+    ref, fns = _SHARED_JITS.setdefault(id(module), (module, {}))
+    if ref is not module:  # unreachable while entries pin their module
+        ref, fns = _SHARED_JITS[id(module)] = (module, {})
+    if kind not in fns:
+        fns[kind] = build()
+    return fns[kind]
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What `ServingEngine.resume` reconstructed from a journal or snapshot.
+
+    ``resumed`` requests were mid-decode at the crash and re-enter admission
+    with their emitted tokens as a continuation prefill; ``restored`` were
+    still queued and re-enter the queue in submit order. ``completed`` maps
+    request id -> the terminal `RequestOutput` recovered from journal FINISH
+    records (dedupe these against any results the dead process already
+    delivered). ``expired`` are queued requests whose wall-clock
+    ``deadline_s`` elapsed during the downtime — rejected at restore time
+    with ``rejected:deadline``, reported here rather than silently dropped.
+    """
+
+    source: str
+    resumed: list[int] = dataclasses.field(default_factory=list)
+    restored: list[int] = dataclasses.field(default_factory=list)
+    completed: dict[int, RequestOutput] = dataclasses.field(default_factory=dict)
+    expired: list[RequestOutput] = dataclasses.field(default_factory=list)
+    downtime_s: float = 0.0
+    truncated_tail_bytes: int = 0
 
 
 class ServingEngine:
@@ -182,6 +236,7 @@ class ServingEngine:
         mesh: Any = None,
         param_rules: Any = None,
         collective_probe_every: int = 0,
+        journal: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -357,6 +412,20 @@ class ServingEngine:
         self._step_count = 0
         self._vocab = int(getattr(module.config, "vocab_size", 0) or 0)
         self._draining = False
+        # durable request journal (serving/journal.py): every accepted submit
+        # is on disk before the caller sees accepted=True, progress/finish
+        # records make the engine preemption-tolerant (ServingEngine.resume).
+        # ``journal=`` accepts a path or a pre-built RequestJournal; None (the
+        # default) keeps the engine fully journal-free.
+        self.journal: RequestJournal | None = None
+        if journal is not None:
+            self.journal = (journal if isinstance(journal, RequestJournal)
+                            else RequestJournal(journal, metrics=self.metrics))
+            if self.journal.metrics is None:
+                self.journal.metrics = self.metrics
+        # tokens of the slot's CURRENT stream already journaled (progress
+        # records are batched to the journal's ``progress_every`` cadence)
+        self._slot_logged = np.zeros(b, np.int64)
         # prefix KV reuse (serving/prefix_cache.py): admission skips prefill
         # of prompt prefixes already resident in the block pool, retirement
         # donates finished prompts back. Off by default — the cache-off
@@ -486,7 +555,8 @@ class ServingEngine:
                     jax.random.key_data(new_rngs), ok | finished)
 
         if self.mesh is None:
-            return jax.jit(step_fn, donate_argnums=(0,))
+            return _shared_jit(module, "step",
+                               lambda: jax.jit(step_fn, donate_argnums=(0,)))
         # explicit shardings pin the hot loop's layout: the donated cache keeps
         # its pool placement through every step (in == out, no resharding) and
         # each [b] state vector rides the slot dim's layout
@@ -547,7 +617,8 @@ class ServingEngine:
                     d_finished, d_remaining, rng_data)
 
         if self.mesh is None:
-            return jax.jit(admit_fn, donate_argnums=(0,))
+            return _shared_jit(module, "admit",
+                               lambda: jax.jit(admit_fn, donate_argnums=(0,)))
         # the [nb] admission inputs (padded prompts, lens, sampling params,
         # seeds) are replicated — nb is small and the prefill's activations
         # shard over heads via the param/TP rules; the [b] per-slot vectors
@@ -615,7 +686,8 @@ class ServingEngine:
                     d_finished, d_remaining, rng_data)
 
         if self.mesh is None:
-            return jax.jit(admit_fn, donate_argnums=(0,))
+            return _shared_jit(module, "cached_admit",
+                               lambda: jax.jit(admit_fn, donate_argnums=(0,)))
         # block pool: heads sharded, blocks replicated across replicas (any
         # replica gathers any cached prefix); everything else as plain admission
         row, rep = self._row_sharding, self._rep_sharding
@@ -634,8 +706,10 @@ class ServingEngine:
         this request right now (its uncached suffix) — the grouping key for
         suffix-bucketed batched admission. Probing never pins; the real match
         re-walks (and pins) at admission."""
-        if not request.cache_prefix:
-            return len(request.prompt)
+        if not request.cache_prefix or request.resume_tokens:
+            # a resumed stream prefills prompt + emitted tokens as one plain
+            # continuation pass — it never rides the block-pool gather
+            return request.prefill_len
         return len(request.prompt) - self.prefix_cache.match_len(request.prompt)
 
     # --------------------------------------------------------------- requests
@@ -659,6 +733,11 @@ class ServingEngine:
                                 "engine is draining toward shutdown")
         result = self.scheduler.submit(request)
         if result.accepted:
+            # WRITE-AHEAD: the acceptance is durable before the caller sees
+            # it — a crash after this line can lose the reply, never the
+            # request (ServingEngine.resume replays it)
+            if self.journal is not None:
+                self.journal.log_submit(request)
             self.metrics.requests_submitted.inc()
         else:
             self.metrics.requests_rejected.inc()
@@ -780,6 +859,8 @@ class ServingEngine:
         queued = self.scheduler.cancel(request_id)
         if queued is not None:
             self.metrics.requests_cancelled.inc()
+            if self.journal is not None:
+                self.journal.log_finish(request_id, FINISH_ABORTED, [])
             return RequestOutput(
                 request_id=request_id, prompt_len=len(queued.prompt), tokens=[],
                 finish_reason=FINISH_ABORTED, arrival_time=queued.arrival_time,
@@ -793,11 +874,31 @@ class ServingEngine:
                 return finished[0]
         return None
 
+    @property
+    def draining(self) -> bool:
+        """True between `begin_drain` and `end_drain` (or while `drain` runs):
+        every new `submit` is rejected with `REJECT_DRAINING`."""
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting NEW submits (rejected with `REJECT_DRAINING`) while
+        the caller serves out the backlog itself — the incremental half of
+        `drain` for callers that interleave stepping with other shutdown
+        work (e.g. the serving preemption handler's grace-window loop)."""
+        self._draining = True
+
+    def end_drain(self) -> None:
+        """Re-open admission after a `begin_drain` (a cancelled shutdown)."""
+        self._draining = False
+
     def drain(self, max_steps: int | None = None) -> list[RequestOutput]:
         """Graceful shutdown: stop admitting NEW submits (rejected with
         `REJECT_DRAINING`) and serve everything already queued/active to
-        completion. ``max_steps`` bounds the wait; leftovers are aborted."""
-        self._draining = True
+        completion. ``max_steps`` bounds the wait; leftovers are aborted.
+        Outputs are returned in COMPLETION order (the order `step` observed
+        each finish), with any ``max_steps`` abort tail appended in
+        queue-then-slot order (`abort_all`). Admission re-opens on return."""
+        self.begin_drain()
         outputs: list[RequestOutput] = []
         steps = 0
         try:
@@ -808,27 +909,281 @@ class ServingEngine:
                     outputs.extend(self.abort_all())
                     break
         finally:
-            self._draining = False
+            self.end_drain()
         return outputs
 
     def abort_all(self) -> list[RequestOutput]:
         """Hard shutdown: abort every queued and active request with
         `FINISH_ABORTED` (partial tokens kept for active ones). In-flight
-        device results are discarded unfetched."""
+        device results are discarded unfetched. Output order is the contract
+        tests rely on: first the QUEUE in FIFO submit order, then active
+        slots in ascending slot index."""
         now = time.perf_counter()
         aborted: list[RequestOutput] = []
         for req in self.scheduler.drain_queue():
             self.metrics.requests_cancelled.inc()
+            if self.journal is not None:
+                self.journal.log_finish(req.request_id, FINISH_ABORTED,
+                                        list(req.resume_tokens))
             aborted.append(RequestOutput(
-                request_id=req.request_id, prompt_len=len(req.prompt), tokens=[],
-                finish_reason=FINISH_ABORTED, arrival_time=req.arrival_time,
-                finish_time=now,
+                request_id=req.request_id, prompt_len=len(req.prompt),
+                tokens=list(req.resume_tokens),  # a restored request's
+                finish_reason=FINISH_ABORTED,    # recovered prefix is output
+                arrival_time=req.arrival_time, finish_time=now,
             ))
         for slot in np.flatnonzero(self._active):
             self.metrics.requests_cancelled.inc()
             self._retire(int(slot), FINISH_ABORTED, now, aborted)
         self._inflight.clear()  # every entry now predates a generation bump
         return aborted
+
+    # ------------------------------------------------------ snapshot / resume
+    def _entry(self, request: Request, tokens: list[int], admitted: bool,
+               now: float) -> dict[str, Any]:
+        """One snapshot line: the request's journal identity plus its stream
+        state — enough for `resume` to rebuild it exactly."""
+        rec = request_record(request)
+        rec.pop("rid", None)
+        return {
+            "rid": request.request_id,
+            **rec,
+            "toks": [int(t) for t in tokens],
+            "retries": int(request.retries),
+            "admitted": bool(admitted),
+            "waited_s": (max(0.0, now - request.arrival_time)
+                         if request.arrival_time is not None else 0.0),
+        }
+
+    def snapshot(self, path: str | os.PathLike) -> list[RequestOutput]:
+        """Capture everything needed to continue this engine's work in a new
+        process: queue order, per-slot emitted tokens, retry counts, and the
+        id watermark (rng state and budgets are derivable — seeds plus token
+        counts). Sampling seeds make the snapshot exact: `resume` in a fresh
+        engine continues every stream bit-for-bit.
+
+        The in-flight dispatch pipeline is drained first (fetches only — no
+        new work is dispatched), so the snapshot is a CONSISTENT frontier;
+        finishes observed during that drain are returned and must be
+        delivered/recorded by the caller like any `step()` result. The file
+        is written atomically (tmp + fsync + rename): a crash mid-snapshot
+        leaves the previous snapshot (or none), never a torn one.
+        """
+        finished: list[RequestOutput] = []
+        self._drain_to(0, finished)
+        now = time.perf_counter()
+        entries: list[dict[str, Any]] = []
+        # slot order approximates admission order well enough for FIFO
+        # fairness on restore; correctness never depends on it (each stream
+        # is independently positioned by its own token count)
+        for slot in range(self.max_concurrency):
+            if not self._active[slot]:
+                continue
+            request, out = self._slot_req[slot], self._slot_out[slot]
+            entries.append(self._entry(request, out.tokens, True, now))
+        for request in self.scheduler.snapshot_queue():
+            entries.append(self._entry(
+                request, request.resume_tokens,
+                admitted=bool(request.resume_tokens), now=now,
+            ))
+        data = {
+            "format": SNAPSHOT_FORMAT,
+            "ts": time.time(),
+            "next_id": self._next_id,
+            "draining": self._draining,
+            "entries": entries,
+        }
+        path = Path(path)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(data, separators=(",", ":")).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return finished
+
+    def _load_recovery_source(self, path: Path) -> tuple[
+            str, dict[int, RequestOutput], list[dict], float, int, int]:
+        """Normalize a journal file or a snapshot file into (kind, completed
+        outputs, pending entries, wall ts of the crash frontier, next_id
+        floor, torn tail bytes)."""
+        with open(path, "rb") as f:
+            head = f.read(len(JOURNAL_MAGIC))
+        if head == JOURNAL_MAGIC:
+            scan: JournalScan = RequestJournal.scan(path)
+            completed = {
+                rid: RequestOutput(
+                    request_id=rid,
+                    prompt_len=len(scan.submits[rid]["prompt"]),
+                    tokens=list(toks), finish_reason=reason,
+                )
+                for rid, (reason, toks) in scan.finishes.items()
+            }
+            entries = []
+            admitted = set(scan.admit_order)
+            for rid in scan.incomplete():
+                rec = scan.submits[rid]
+                entries.append({
+                    "rid": rid,
+                    "prompt": rec["prompt"],
+                    "params": rec["params"],
+                    "deadline_s": rec.get("deadline_s"),
+                    "cache_prefix": rec.get("cache_prefix", True),
+                    "toks": scan.tokens.get(rid, []),
+                    "retries": 0,
+                    "admitted": rid in admitted,
+                    "waited_s": max(0.0, scan.last_ts - float(rec.get("ts", scan.last_ts))),
+                })
+            return ("journal", completed, entries, scan.last_ts,
+                    max(scan.submits, default=-1) + 1,
+                    scan.truncated_tail_bytes)
+        data = json.loads(path.read_bytes())
+        if data.get("format") != SNAPSHOT_FORMAT:
+            raise ValueError(
+                f"{path} is neither a request journal nor a "
+                f"{SNAPSHOT_FORMAT} snapshot"
+            )
+        return ("snapshot", {}, list(data.get("entries", ())),
+                float(data.get("ts", 0.0)), int(data.get("next_id", 0)), 0)
+
+    def resume(self, path: str | os.PathLike | None = None) -> RecoveryReport:
+        """Crash-exact recovery: rebuild this (idle, freshly constructed)
+        engine's queue from a durable source — the engine's own journal
+        (default), another journal, or a `snapshot` file.
+
+        - requests with a FINISH record come back in ``report.completed``
+          (token streams included) and are NOT replayed — dedupe them against
+          whatever the dead process already delivered;
+        - requests that were mid-decode are re-admitted FIRST (admission
+          order), each carrying its emitted tokens as ``resume_tokens``: one
+          continuation prefill + a fast-forwarded rng chain continues the
+          stream bit-for-bit (an already-satisfied budget or an emitted EOS
+          completes it right here instead). Their ``deadline_s`` is cleared —
+          the queue-wait deadline was consumed by the pre-crash admission,
+          so a restored in-flight request can never instantly expire;
+        - still-queued requests re-enter the queue in submit order. One with
+          a ``deadline_s`` whose WALL-CLOCK budget fully elapsed during the
+          downtime is expired now with ``rejected:deadline`` (reported in
+          ``report.expired``, journaled, counted — never silently dropped);
+          survivors resume with only their pre-crash queue wait counted, so
+          the downtime itself never eats the remaining deadline budget.
+        """
+        if path is None:
+            if self.journal is None:
+                raise ValueError("resume() needs a path when the engine has "
+                                 "no journal configured")
+            path = self.journal.path
+        path = Path(path)
+        if self._active.any() or self.scheduler.queue_depth or self._inflight:
+            raise RuntimeError("resume() requires an idle engine — restore "
+                               "into a freshly constructed one")
+        kind, completed, entries, last_ts, next_id, tail = \
+            self._load_recovery_source(path)
+        wall_now = time.time()
+        perf_now = time.perf_counter()
+        downtime = max(0.0, wall_now - last_ts) if last_ts else 0.0
+        report = RecoveryReport(source=kind, downtime_s=downtime,
+                                truncated_tail_bytes=tail,
+                                completed=completed)
+        # replaying into our OWN journal would duplicate SUBMITs; a foreign
+        # source (snapshot, or someone else's journal) must be copied in so
+        # the new journal is self-contained for the NEXT crash
+        foreign = (self.journal is not None
+                   and Path(self.journal.path).resolve() != path.resolve())
+        eos = self.eos_token_id
+        for e in entries:
+            rid = int(e["rid"])
+            prompt = [int(t) for t in e["prompt"]]
+            plen = len(prompt)
+            toks = [int(t) for t in e.get("toks", ())]
+            sp = SamplingParams(
+                temperature=float(e["params"]["temperature"]),
+                top_k=e["params"]["top_k"],
+                seed=int(e["params"]["seed"]),
+                max_new_tokens=int(e["params"]["max_new_tokens"]),
+            )
+            admitted = bool(e.get("admitted"))
+            deadline = e.get("deadline_s")
+            waited = float(e.get("waited_s", 0.0))
+            budget = min(sp.max_new_tokens, self.max_len - plen)
+            # a stream that already finished but whose FINISH record was lost
+            # with the crash (or that snapshotted right at its end) completes
+            # HERE — re-admitting it would overrun its budget
+            done_reason = None
+            if eos is not None and eos in toks:
+                toks = toks[: toks.index(eos) + 1]
+                done_reason = FINISH_EOS
+            elif len(toks) >= budget:
+                toks = toks[:budget]
+                done_reason = FINISH_LENGTH
+            if done_reason is not None:
+                out = RequestOutput(request_id=rid, prompt_len=plen,
+                                    tokens=toks, finish_reason=done_reason)
+                report.completed[rid] = out
+                if self.journal is not None:
+                    if foreign:
+                        req = Request(prompt=prompt, params=sp, request_id=rid)
+                        self.journal.log_submit(req)
+                    self.journal.log_finish(rid, done_reason, toks)
+                continue
+            if not admitted and deadline is not None \
+                    and waited + downtime >= float(deadline):
+                # the client's wall-clock patience ran out while we were
+                # down: reject loudly, exactly as queue expiry would have
+                self.metrics.requests_expired.inc()
+                out = RequestOutput(
+                    request_id=rid, prompt_len=plen, tokens=[],
+                    finish_reason=f"rejected:{REJECT_DEADLINE}",
+                    finish_time=perf_now,
+                )
+                report.expired.append(out)
+                if self.journal is not None:
+                    if foreign:
+                        req = Request(prompt=prompt, params=sp, request_id=rid,
+                                      deadline_s=deadline)
+                        self.journal.log_submit(req)
+                    self.journal.log_finish(
+                        rid, f"rejected:{REJECT_DEADLINE}", [])
+                continue
+            # the resume point must fit a prompt bucket; a too-long stream
+            # rewinds to the largest admissible prefix and re-decodes the
+            # rest (deterministic, so the final stream is unchanged)
+            keep = max(0, min(len(toks), self.scheduler.max_prompt_len - plen))
+            request = Request(
+                prompt=prompt, params=sp, request_id=rid,
+                # an admitted request's queue-wait deadline was already
+                # consumed pre-crash; keeping it would instantly expire the
+                # restored stream
+                deadline_s=None if admitted else deadline,
+                cache_prefix=bool(e.get("cache_prefix", True)),
+                retries=int(e.get("retries", 0)),
+                resume_tokens=toks[:keep],
+                arrival_time=perf_now - waited,
+            )
+            result = self.scheduler.submit(request)
+            if not result.accepted:
+                raise RuntimeError(
+                    f"restored request {rid} rejected ({result.reason}): the "
+                    f"resuming engine's scheduler is configured smaller than "
+                    f"the crashed one's (queue/buckets must cover the "
+                    f"recovered backlog)"
+                )
+            self.metrics.mark_start()
+            self.metrics.requests_submitted.inc()
+            if foreign and self.journal is not None:
+                self.journal.log_submit(request)
+                if request.resume_tokens:
+                    self.journal.log_progress(
+                        rid, request.resume_tokens, len(request.resume_tokens))
+            if admitted:
+                self.metrics.requests_resumed.inc()
+                report.resumed.append(rid)
+            else:
+                self.metrics.requests_restored.inc()
+                report.restored.append(rid)
+        all_rids = ([e["rid"] for e in entries] + list(report.completed)
+                    + [next_id - 1])
+        self._next_id = max(self._next_id, max(all_rids, default=-1) + 1)
+        return report
 
     # -------------------------------------------------------------- internals
     def _poison_mask(self) -> np.ndarray | None:
@@ -900,6 +1255,13 @@ class ServingEngine:
             out.tokens.append(token)
             self.metrics.tokens_generated.inc()
             self._slot_last_token_t[slot] = now
+            if self.journal is not None:
+                # durable first-token edge (n > 1 marks a resumed stream's
+                # first NEW token — replay applies them uniformly)
+                self.journal.log_first_token(
+                    out.request_id, token, len(out.tokens)
+                )
+                self._slot_logged[slot] = len(out.tokens)
             if fins[i]:
                 reason = (FINISH_EOS if self.eos_token_id is not None
                           and token == self.eos_token_id else FINISH_LENGTH)
@@ -922,6 +1284,14 @@ class ServingEngine:
             self.metrics.tokens_generated.inc()
             self.metrics.inter_token_s.observe(now - self._slot_last_token_t[slot])
             self._slot_last_token_t[slot] = now
+            if (self.journal is not None
+                    and len(out.tokens) - self._slot_logged[slot]
+                    >= self.journal.progress_every):
+                self.journal.log_progress(
+                    out.request_id, out.tokens[self._slot_logged[slot]:],
+                    len(out.tokens),
+                )
+                self._slot_logged[slot] = len(out.tokens)
             if fins[slot]:
                 reason = (FINISH_EOS if self.eos_token_id is not None
                           and token == self.eos_token_id else FINISH_LENGTH)
@@ -955,6 +1325,10 @@ class ServingEngine:
             # expired while queued: reject rather than serve a reply the
             # client has already abandoned (REJECT_DEADLINE, never admitted)
             self.metrics.requests_expired.inc()
+            if self.journal is not None:
+                self.journal.log_finish(
+                    request.request_id, f"rejected:{REJECT_DEADLINE}", []
+                )
             finished.append(RequestOutput(
                 request_id=request.request_id, prompt_len=len(request.prompt),
                 tokens=[], finish_reason=f"rejected:{REJECT_DEADLINE}",
@@ -973,7 +1347,8 @@ class ServingEngine:
                 # and this acquire, so the match agrees with the suffix bucket
                 # the group was sized by
                 matches = [
-                    self.prefix_cache.acquire(r.prompt) if r.cache_prefix
+                    self.prefix_cache.acquire(r.prompt)
+                    if r.cache_prefix and not r.resume_tokens
                     else NO_MATCH
                     for r in group
                 ]
@@ -981,7 +1356,7 @@ class ServingEngine:
                     self._admit_group_cached(group, matches, finished)
                     continue
                 for r in group:
-                    if r.cache_prefix:
+                    if r.cache_prefix and not r.resume_tokens:
                         self.metrics.prefix_misses.inc()
             # all-miss (or cache off): the plain admission program — with the
             # prefix cache disabled this path is bit-for-bit the pre-cache one
@@ -991,7 +1366,7 @@ class ServingEngine:
                      finished: list[RequestOutput]) -> None:
         nb = len(group)
         slots = [self._free.popleft() for _ in group]
-        bucket = self.scheduler.bucket_for(max(len(r.prompt) for r in group))
+        bucket = self.scheduler.bucket_for(max(r.prefill_len for r in group))
         padded = np.zeros((nb, bucket), np.int32)
         lens = np.zeros(nb, np.int32)
         temps = np.zeros(nb, np.float32)
@@ -1000,15 +1375,31 @@ class ServingEngine:
         rng_rows = []
         for i, request in enumerate(group):
             plen = len(request.prompt)
-            padded[i, :plen] = request.prompt
-            lens[i] = plen
+            k = len(request.resume_tokens)
+            # a resumed request (crash recovery) prefills prompt + its
+            # already-emitted tokens in ONE continuation pass: same numerics
+            # as the original prefill-then-decode, so the stream stays
+            # bit-identical (tests/test_serving_recovery.py)
+            ptoks = request.prefill_source()
+            padded[i, : plen + k] = ptoks
+            lens[i] = plen + k
             sp = request.params
             temps[i] = sp.temperature
             topks[i] = sp.top_k or 0
-            # the context is fixed-size: cap generation so cache writes
-            # stay inside [0, n_positions)
-            budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen)
-            rng_rows.append(jax.random.key_data(jax.random.key(sp.seed)))
+            # the context is fixed-size: cap generation so cache writes stay
+            # inside [0, n_positions). The cap is against the ORIGINAL prompt
+            # (a resumed request keeps the budget it started with, minus the
+            # k tokens it already emitted)
+            budgets[i] = min(int(sp.max_new_tokens), self.max_len - plen) - k
+            # the rng chain advances one split per sampled token; fast-forward
+            # a resumed request's chain past its k replayed tokens so the
+            # next sample draws exactly the key the uninterrupted run would
+            key = jax.random.key(sp.seed)
+            for _ in range(k):
+                key = jax.random.split(key)[0]
+            rng_rows.append(jax.random.key_data(key))
+            if k:
+                self.metrics.replayed_tokens.inc(plen + k)
         (self._cache, first, fin0, self._d_tokens, self._d_pos,
          self._d_temps, self._d_topks, self._d_finished,
          self._d_remaining, self._rng_data) = self._dispatch(
@@ -1105,8 +1496,14 @@ class ServingEngine:
             self._slot_req[slot] = request
             self._slot_out[slot] = RequestOutput(
                 request_id=request.request_id, prompt_len=len(request.prompt),
-                tokens=[], finish_reason="", arrival_time=request.arrival_time,
+                # a resumed stream's recovered prefix is part of the output;
+                # decode appends from token k+1
+                tokens=list(request.resume_tokens), finish_reason="",
+                arrival_time=request.arrival_time,
             )
+            # the recovered prefix came FROM the journal/snapshot — only
+            # tokens past it need (re-)journaling
+            self._slot_logged[slot] = len(request.resume_tokens)
             self._active[slot] = True
             if matches is not None:
                 m = matches[i]
@@ -1130,13 +1527,22 @@ class ServingEngine:
         if out.arrival_time is not None:
             self.metrics.request_latency_s.observe(max(0.0, now - out.arrival_time))
         self.metrics.requests_finished.inc()
+        if self.journal is not None:
+            # the terminal record carries the whole stream: completed work is
+            # parity-checkable and dedupable from the journal alone
+            self.journal.log_finish(out.request_id, reason, out.tokens)
         if (self.prefix_cache is not None and reason != FINISH_ERROR
-                and self._slot_req[slot].cache_prefix):
+                and self._slot_req[slot].cache_prefix
+                and not self._slot_req[slot].resume_tokens):
             # donate the retired slot's prompt-region KV to the prefix pool.
             # Safe under pipelining: decode writes land at >= prompt_len and a
             # finished slot is frozen by its on-device mask, so [0, prompt_len)
             # is exactly the admission-time prefill whenever we get here. A
-            # FINISH_ERROR slot is poisoned — never donate it.
+            # FINISH_ERROR slot is poisoned — never donate it. A resumed
+            # stream is excluded too: its prompt rows came from a
+            # continuation prefill padded to a bigger bucket than a cold
+            # prefill of the prompt alone would use, and donated rows must
+            # only ever be ones a cold path would have produced.
             self.prefix_cache.insert(
                 self._slot_req[slot].prompt, self._cache, slot
             )

@@ -125,6 +125,18 @@ class ServingMetrics:
         self.prefix_blocks_donated = Counter()
         self.prefix_evictions = Counter()
         self.steps = Counter()
+        # durability / recovery telemetry (serving/journal.py + engine
+        # snapshot/resume — docs/reliability.md "Serving recovery"): journal
+        # records and bytes appended by this engine; requests a `resume()`
+        # re-admitted MID-STREAM (continuation prefill from journal/snapshot
+        # tokens) vs. re-enqueued from the queue; and prompt+stream tokens
+        # re-prefilled solely because of the restart (the replay cost a
+        # tighter progress cadence would shrink)
+        self.journal_records = Counter()
+        self.journal_bytes = Counter()
+        self.requests_resumed = Counter()
+        self.requests_restored = Counter()
+        self.replayed_tokens = Counter()
         # mesh-sharded serving telemetry (engine ``mesh=``): per-step wall
         # seconds of the cross-device sync probe (a tiny jitted all-reduce
         # over every mesh axis, dispatched+blocked right after the decode
@@ -204,6 +216,11 @@ class ServingMetrics:
             "serving/prefix_blocks_donated": self.prefix_blocks_donated.value,
             "serving/prefix_evictions": self.prefix_evictions.value,
             "serving/steps": self.steps.value,
+            "serving/journal_records": self.journal_records.value,
+            "serving/journal_bytes": self.journal_bytes.value,
+            "serving/requests_resumed": self.requests_resumed.value,
+            "serving/requests_restored": self.requests_restored.value,
+            "serving/replayed_tokens": self.replayed_tokens.value,
             "serving/tokens_per_sec": self.tokens_per_sec(),
             "serving/compile_count": self.compile_count.value,
         }

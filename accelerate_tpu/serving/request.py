@@ -53,6 +53,14 @@ class Request:
     prompt is always prefilled from token 0 and its KV is never donated to
     the shared pool (`serving/prefix_cache.py` — opt out for privacy-scoped
     prompts or A/B measurement; tokens are identical either way).
+
+    ``resume_tokens`` is the crash-recovery handle (`docs/reliability.md`
+    "Serving recovery"): tokens this request had ALREADY emitted before an
+    engine restart. Admission then prefills ``prompt + resume_tokens`` in one
+    pass and fast-forwards the request's rng chain by ``len(resume_tokens)``
+    splits, so decode continues mid-stream bit-for-bit with an uninterrupted
+    run. Stamped by `ServingEngine.resume` — normal submissions leave it
+    empty.
     """
 
     prompt: list[int]
@@ -62,6 +70,18 @@ class Request:
     deadline_s: float | None = None
     retries: int = 0
     cache_prefix: bool = True
+    resume_tokens: list[int] = field(default_factory=list)
+
+    @property
+    def prefill_len(self) -> int:
+        """Tokens admission must fit in a prompt bucket: the prompt plus any
+        resumed stream prefix (what actually gets prefilled)."""
+        return len(self.prompt) + len(self.resume_tokens)
+
+    def prefill_source(self) -> list[int]:
+        """The token sequence admission prefills for this request."""
+        return (self.prompt + self.resume_tokens if self.resume_tokens
+                else self.prompt)
 
 
 @dataclass

@@ -56,11 +56,16 @@ class FIFOScheduler:
         )
 
     def submit(self, request: Request) -> SubmitResult:
-        """Enqueue or reject-with-reason (never blocks, never raises on load)."""
-        n = len(request.prompt)
-        if n == 0:
+        """Enqueue or reject-with-reason (never blocks, never raises on load).
+
+        Validation is against the PREFILL length — prompt plus any resumed
+        stream prefix (`Request.resume_tokens`): a restored mid-flight
+        request must fit a bucket just like a fresh prompt would.
+        """
+        if len(request.prompt) == 0:
             return SubmitResult(False, request.request_id, REJECT_EMPTY_PROMPT,
                                 "prompt has no tokens")
+        n = request.prefill_len
         if n > self.max_prompt_len or n > self.buckets[-1]:
             return SubmitResult(
                 False, request.request_id, REJECT_PROMPT_TOO_LONG,
@@ -80,9 +85,10 @@ class FIFOScheduler:
 
     def prefill_bucket_for(self, request: Request) -> int:
         """The bucket admission will pad this request's PREFILL to: its full
-        prompt bucket, or — with a prefix cache probing via
-        ``prefill_len_fn`` — the bucket of just the uncached suffix."""
-        n = len(request.prompt)
+        prompt bucket (prompt + resumed prefix), or — with a prefix cache
+        probing via ``prefill_len_fn`` — the bucket of just the uncached
+        suffix."""
+        n = request.prefill_len
         if self.prefill_len_fn is not None:
             n = max(1, min(n, int(self.prefill_len_fn(request))))
         return self.bucket_for(n)
@@ -93,10 +99,13 @@ class FIFOScheduler:
         cached and an uncached admission must never share one run: they take
         DIFFERENT jitted programs (cached-gather vs plain prefill), so a mixed
         group would both recompile per mix pattern and push opted-out
-        (privacy-scoped) prompts through the block-pool gather path."""
+        (privacy-scoped) prompts through the block-pool gather path. A
+        resumed request (``resume_tokens``) always rides the plain program —
+        its continuation prefill never matches the block pool."""
         return (
             self.prefill_bucket_for(request),
-            bool(request.cache_prefix) if self.prefill_len_fn is not None else False,
+            (bool(request.cache_prefix) and not request.resume_tokens)
+            if self.prefill_len_fn is not None else False,
         )
 
     def peek_run(self, max_n: int) -> int:
@@ -146,6 +155,11 @@ class FIFOScheduler:
                 self._queue.remove(r)
                 return r
         return None
+
+    def snapshot_queue(self) -> list[Request]:
+        """The queued requests in order, WITHOUT removing them (the engine's
+        `snapshot` serializes the queue through this)."""
+        return list(self._queue)
 
     def drain_queue(self) -> list[Request]:
         """Remove and return everything queued (abort_all's shutdown path)."""

@@ -243,6 +243,41 @@ def test_sigterm_preemption_lands_synchronous_checkpoint(tmp_path, fault_injecti
     np.testing.assert_allclose(np.asarray(model.params["a"]), trained_a)
 
 
+def test_second_sigterm_during_checkpoint_does_not_reenter_save():
+    """Regression: platforms re-deliver SIGTERM as the kill escalates; a
+    second signal landing while the synchronous ``save_state`` is mid-write
+    must be swallowed by the re-entrancy guard — re-entering the save would
+    corrupt the very checkpoint the grace window exists to land."""
+    import os
+    import signal as sig
+
+    from accelerate_tpu.reliability import PreemptionHandler
+
+    calls = {"n": 0}
+
+    class Acc:
+        def save_state(self, output_dir, async_save=False):
+            calls["n"] += 1
+            os.kill(os.getpid(), sig.SIGTERM)  # second preemption mid-save
+            deadline = time.monotonic() + 5.0
+            while handler.signals_seen < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)  # the nested handler runs between bytecodes
+            return "ckpt-dir"
+
+    handler = PreemptionHandler(Acc(), exit_on_preempt=False).install()
+    try:
+        os.kill(os.getpid(), sig.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not handler.preempted and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert handler.preempted
+        assert handler.signals_seen == 2  # both deliveries observed...
+        assert calls["n"] == 1  # ...but save_state ran exactly once
+        assert handler.checkpoint_dir == "ckpt-dir"
+    finally:
+        handler.uninstall()
+
+
 # ------------------------------------------------------------------ chaos serve
 def test_chaos_serve_replay_loses_zero_requests():
     pytest.importorskip("flax.linen")

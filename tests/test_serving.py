@@ -496,6 +496,63 @@ def test_abort_all_returns_partial_outputs(model):
     assert not engine.has_work and engine.active_slots == 0
 
 
+def test_begin_drain_rejects_every_submit_until_end_drain(model):
+    """The incremental drain API: from `begin_drain` on, EVERY submit —
+    first, repeated, mid-backlog — is rejected with `REJECT_DRAINING`;
+    `end_drain` re-opens admission (a cancelled shutdown)."""
+    module, params = model
+    prompts = _prompts(21, [4, 5, 6])
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    assert engine.submit(Request(prompts[0], SamplingParams(max_new_tokens=4))).accepted
+    assert not engine.draining
+    engine.begin_drain()
+    assert engine.draining
+    for p in prompts:  # consistent across calls, not just the first
+        r = engine.submit(Request(p, SamplingParams(max_new_tokens=4)))
+        assert not r.accepted and r.reason == REJECT_DRAINING
+    # serving the backlog out does NOT re-open admission by itself
+    while engine.has_work:
+        engine.step()
+    r = engine.submit(Request(prompts[1], SamplingParams(max_new_tokens=4)))
+    assert not r.accepted and r.reason == REJECT_DRAINING
+    assert engine.metrics.requests_rejected.value == len(prompts) + 1
+    engine.end_drain()
+    assert engine.submit(Request(prompts[2], SamplingParams(max_new_tokens=4))).accepted
+
+
+def test_drain_returns_outputs_in_completion_order(model):
+    """`drain` documents COMPLETION order: a short request admitted alongside
+    a long one must appear first, whatever the submit order was."""
+    module, params = model
+    long_p, short_p = _prompts(22, [4, 4])
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    long_id = engine.submit(Request(long_p, SamplingParams(max_new_tokens=24))).request_id
+    short_id = engine.submit(Request(short_p, SamplingParams(max_new_tokens=3))).request_id
+    outs = engine.drain()
+    assert [o.request_id for o in outs] == [short_id, long_id]
+    assert all(o.finish_reason == FINISH_LENGTH for o in outs)
+    assert not engine.draining  # drain re-opens admission on return
+
+
+def test_abort_all_orders_queue_fifo_then_slots_ascending(model):
+    """`abort_all` documents its output order — queued requests in FIFO
+    submit order first, then active slots in ascending slot index — so
+    shutdown reporting is deterministic."""
+    module, params = model
+    prompts = _prompts(23, [4, 4, 4, 4, 4])
+    engine = ServingEngine(module, params, max_concurrency=2, prompt_buckets=(8,))
+    for p in prompts:
+        engine.submit(Request(p, SamplingParams(max_new_tokens=32)))
+    engine.step()  # rids 0,1 admitted to slots 0,1; rids 2,3,4 stay queued
+    assert engine.active_slots == 2 and engine.scheduler.queue_depth == 3
+    aborted = engine.abort_all()
+    assert [o.request_id for o in aborted] == [2, 3, 4, 0, 1]
+    queued, active = aborted[:3], aborted[3:]
+    assert all(o.tokens == [] for o in queued)
+    assert all(len(o.tokens) > 0 for o in active)
+    assert not engine.has_work and engine.active_slots == 0
+
+
 def test_run_max_steps_aborts_leftovers_and_keeps_completed(model):
     """run(max_steps=...) must return the completed outputs (not raise them
     away) and abort whatever is still in flight with FINISH_ABORTED."""

@@ -26,6 +26,20 @@ reference pass when you only want the lost-request invariant).
 
 Prints ONE JSON line: {"metric": "chaos_serve_lost_requests", "value": 0, ...}.
 
+**Crash scenarios** (``CHAOS_SCENARIO=sigterm|sigkill``): instead of the
+fault-injection replay, spawn a CHILD serving process that journals every
+request (`serving/journal.py`), wait until the journal proves it is
+mid-decode (>= 1 FIRST_TOKEN on disk, not all finished), and kill it —
+SIGTERM (the child's `ServingPreemptionHandler` drains inside a short grace
+window, snapshots the rest, exits 143) or SIGKILL (no handler runs; the
+fsync'd journal is the only survivor). The parent then builds a fresh engine,
+`resume`s from the snapshot (sigterm) or the journal (sigkill), runs the
+replayed work to completion, and asserts BOTH invariants across the crash:
+zero lost accepted requests, and zero token drift vs solo generate for every
+cleanly finished stream — including the ones that resumed mid-stream. The
+child blocks SIGTERM around each ``engine.step()`` and unblocks between
+steps, so the handler's drain never re-enters a half-completed step.
+
 Run: JAX_PLATFORMS=cpu python tools/chaos_serve.py
 Env knobs:
   CHAOS_REQUESTS        trace length (default 24)
@@ -49,6 +63,11 @@ Env knobs:
                         deadline expiry, and prefix reuse all ride over
                         collectives. On CPU the D*M virtual devices are
                         forced. Default: unsharded (single device)
+  CHAOS_SCENARIO        "sigterm" or "sigkill" runs the kill-mid-decode
+                        crash scenario instead of the fault-injection replay
+  CHAOS_GRACE           sigterm scenario: the child handler's drain grace
+                        window, seconds (default 0.05 — small on purpose, so
+                        work REMAINS and the snapshot path is exercised)
 """
 
 from __future__ import annotations
@@ -224,7 +243,249 @@ def run(
     }
 
 
+def _crash_child() -> None:
+    """Child half of the crash scenarios: serve the trace with a journal (and,
+    under sigterm, a drain-or-snapshot preemption handler) until killed."""
+    import signal as _signal
+
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.reliability import install_serving_preemption_handler
+    from accelerate_tpu.serving import PrefixCacheConfig, Request, ServingEngine
+
+    n = _env_int("CHAOS_REQUESTS", 12)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    trace = _trace(n, 1e9, _env_int("CHAOS_SEED", 0),
+                   int(module.config.vocab_size))
+    engine = ServingEngine(
+        module, params,
+        max_concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+        prompt_buckets=BUCKETS, max_queue=n + 1,
+        pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+        prefix_cache=(PrefixCacheConfig(num_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6))
+                      if _env_int("CHAOS_PREFIX", 1) else False),
+        journal=os.environ["CHAOS_JOURNAL"],
+    )
+    if os.environ.get("CHAOS_SCENARIO") == "sigterm":
+        install_serving_preemption_handler(
+            engine, os.environ["CHAOS_SNAPSHOT"],
+            grace_s=float(os.environ.get("CHAOS_GRACE", 0.05)),
+        )
+    for src in trace:
+        engine.submit(Request(src.prompt, src.params))
+    while engine.has_work:
+        # deliver-at-step-boundary: SIGTERM is blocked while a step is in
+        # flight and delivered at the unblock, so the handler's drain loop
+        # never re-enters a half-completed step. SIGKILL cannot be blocked —
+        # it kills mid-anything, which is exactly what the journal's torn-tail
+        # tolerance exists for.
+        _signal.pthread_sigmask(_signal.SIG_BLOCK, {_signal.SIGTERM})
+        engine.step()
+        _signal.pthread_sigmask(_signal.SIG_UNBLOCK, {_signal.SIGTERM})
+    # finished everything before the kill landed: park so the parent's signal
+    # still hits a live process (the scenario then degenerates to "all
+    # completed pre-crash", which the recovery asserts trivially)
+    while True:
+        time.sleep(0.05)
+
+
+def run_crash(
+    scenario: str = "sigkill",
+    n_requests: int = 12,
+    concurrency: int = 2,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    prefix_cache: bool = True,
+    prefix_blocks: int = 6,
+    grace_s: float = 0.05,
+    timeout_s: float = 240.0,
+    workdir: str | None = None,
+    verify_parity: bool = True,
+) -> dict:
+    """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
+    fresh engine from what survived on disk, and assert zero lost accepted
+    requests plus zero token drift; return the summary dict (importable —
+    tests/test_serving_recovery.py runs it)."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.reliability import SIGTERM_EXIT_CODE
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        PrefixCacheConfig,
+        RequestJournal,
+        ServingEngine,
+    )
+    from accelerate_tpu.serving.journal import REC_FIRST_TOKEN
+
+    if scenario not in ("sigterm", "sigkill"):
+        raise ValueError(f"unknown crash scenario {scenario!r}")
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_crash_")
+    journal = os.path.join(workdir, "requests.journal")
+    snapshot = os.path.join(workdir, "engine.snap")
+    env = dict(
+        os.environ,
+        CHAOS_CRASH_CHILD="1", CHAOS_JOURNAL=journal, CHAOS_SNAPSHOT=snapshot,
+        CHAOS_SCENARIO=scenario, CHAOS_REQUESTS=str(n_requests),
+        CHAOS_CONCURRENCY=str(concurrency), CHAOS_SEED=str(seed),
+        CHAOS_DEPTH=str(pipeline_depth), CHAOS_PREFIX=str(int(prefix_cache)),
+        CHAOS_PREFIX_BLOCKS=str(prefix_blocks), CHAOS_GRACE=str(grace_s),
+        JAX_PLATFORMS="cpu",
+    )
+    t0 = time.perf_counter()
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    rc = None
+    try:
+        # kill only once the journal PROVES the child is mid-decode: >= 1
+        # FIRST_TOKEN on disk and >= 1 accepted request not yet finished
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and child.poll() is None:
+            if os.path.exists(journal):
+                try:
+                    s = RequestJournal.scan(journal)
+                except Exception:
+                    s = None
+                if (s is not None and s.submits
+                        and s.records_by_type.get(REC_FIRST_TOKEN, 0) >= 1
+                        and any(r not in s.finishes for r in s.submits)):
+                    break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"child never reached mid-decode (rc={child.poll()})")
+        child.send_signal(
+            _signal.SIGTERM if scenario == "sigterm" else _signal.SIGKILL)
+        rc = child.wait(timeout=timeout_s)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    if scenario == "sigterm":
+        assert rc == SIGTERM_EXIT_CODE, f"sigterm child exited {rc}"
+    else:
+        assert rc == -_signal.SIGKILL, f"sigkill child exited {rc}"
+
+    scan = RequestJournal.scan(journal)
+    # sigterm resumes from the handler's snapshot when one landed (the drain
+    # may have finished everything inside the grace window); sigkill always
+    # replays the journal — nothing else survived
+    source = (snapshot if scenario == "sigterm" and os.path.exists(snapshot)
+              else journal)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    engine = ServingEngine(
+        module, params, max_concurrency=concurrency,
+        prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+        pipeline_depth=pipeline_depth,
+        prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
+                      if prefix_cache else False),
+        journal=journal,
+    )
+    report = engine.resume(source)
+    # terminal outcome per accepted rid: child finishes from the journal,
+    # then everything the resumed engine produces on top
+    outcomes: dict[int, tuple[str, list[int]]] = {
+        rid: (reason, toks) for rid, (reason, toks) in scan.finishes.items()
+    }
+    for rid, out in report.completed.items():
+        outcomes[rid] = (out.finish_reason, out.tokens)
+    for out in report.expired:
+        outcomes[out.request_id] = (out.finish_reason, out.tokens)
+    while engine.has_work:
+        for out in engine.step():
+            outcomes[out.request_id] = (out.finish_reason, out.tokens)
+    lost = sorted(rid for rid in scan.submits if rid not in outcomes)
+    assert not lost, (
+        f"lost requests (journaled as accepted, no terminal outcome after "
+        f"{scenario} + resume): {lost}")
+
+    # cross-crash parity: every cleanly finished stream — finished by the
+    # child, drained by its handler, or resumed mid-stream by the fresh
+    # engine — must match solo generate token-for-token. The reference is
+    # reconstructed from the journal's SUBMIT records alone.
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, (reason, toks) in sorted(outcomes.items()):
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            rec = scan.submits[rid]
+            sp = rec["params"]
+            ids = jnp.asarray(np.asarray(rec["prompt"], np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=sp["max_new_tokens"],
+                temperature=sp["temperature"], top_k=sp["top_k"],
+                rng=jax.random.key(sp["seed"]),
+            )
+            checked += 1
+            if toks != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, (
+            f"token drift across {scenario} + resume: requests {drift}")
+
+    m = engine.metrics
+    return {
+        "metric": "chaos_serve_crash_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "scenario": scenario,
+            "child_exit_code": rc,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "prefix_cache": bool(prefix_cache),
+            "finished_pre_crash": len(scan.finishes),
+            "resumed_mid_stream": len(report.resumed),
+            "restored_queued": len(report.restored),
+            "expired_on_restore": len(report.expired),
+            "replayed_tokens": m.replayed_tokens.value,
+            "journal_records": scan.records,
+            "truncated_tail_bytes": scan.truncated_tail_bytes,
+            "resume_source": "snapshot" if source == snapshot else "journal",
+            "downtime_s": round(report.downtime_s, 3),
+            "parity_checked": checked,
+            "parity_drift": len(drift),
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
 def main() -> None:
+    if os.environ.get("CHAOS_CRASH_CHILD"):
+        _crash_child()
+        return
+    if os.environ.get("CHAOS_SCENARIO"):
+        summary = run_crash(
+            scenario=os.environ["CHAOS_SCENARIO"].lower(),
+            n_requests=_env_int("CHAOS_REQUESTS", 12),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            prefix_cache=bool(_env_int("CHAOS_PREFIX", 1)),
+            prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
+            grace_s=float(os.environ.get("CHAOS_GRACE", 0.05)),
+            verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+        )
+        print(json.dumps(summary), flush=True)
+        return
     mesh = None
     if os.environ.get("CHAOS_MESH"):
         d, m = os.environ["CHAOS_MESH"].lower().replace(" ", "").split("x")

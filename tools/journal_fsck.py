@@ -1,0 +1,83 @@
+"""Offline audit / compaction for serving request journals
+(`serving/journal.py`, `docs/reliability.md` "Serving recovery").
+
+Validates every CRC-framed record, partitions requests into finished vs
+in-flight (what a `ServingEngine.resume` would replay), and reports a torn
+final record as the TOLERATED crash frontier — truncated tail bytes are
+expected after a SIGKILL, not corruption. ``--compact`` rewrites the journal
+in place (atomic replace): each in-flight request's PROGRESS chain collapses
+to one cumulative record and finished requests are dropped (keep them with
+``--keep-finished``), which is standard WAL checkpointing.
+
+Prints ONE JSON report line. Exit status: 0 = clean (a truncated tail alone
+is still clean), 1 = mid-file anomalies (records out of order, unknown types,
+tokens for never-submitted rids — a crash cannot explain these), 2 = not a
+journal at all (bad magic / unreadable).
+
+Run:
+    JAX_PLATFORMS=cpu python tools/journal_fsck.py PATH [--compact]
+        [--keep-finished]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.serving.journal import JournalError, RequestJournal  # noqa: E402
+
+
+def fsck(path: str, *, compact: bool = False, keep_finished: bool = False) -> dict:
+    """Scan (and optionally compact) one journal; return the report dict
+    (importable — tests/test_serving_recovery.py runs it)."""
+    scan = RequestJournal.scan(path)
+    report = {
+        "path": str(path),
+        "records": scan.records,
+        "records_by_type": dict(sorted(scan.records_by_type.items())),
+        "bytes": scan.total_bytes,
+        "valid_bytes": scan.valid_bytes,
+        # > 0 marks the record being appended when the process died — the
+        # crash frontier `scan` stops at, tolerated by design
+        "truncated_tail_bytes": scan.truncated_tail_bytes,
+        "anomalies": scan.anomalies,
+        "submitted": len(scan.submits),
+        "finished": len(scan.finishes),
+        "in_flight": [
+            {"rid": rid, "tokens": len(scan.tokens.get(rid, []))}
+            for rid in scan.incomplete()
+        ],
+        "clean": scan.anomalies == 0,
+    }
+    if compact:
+        RequestJournal.compact(path, keep_finished=keep_finished)
+        report["compacted_bytes"] = os.path.getsize(path)
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="journal file to audit")
+    parser.add_argument("--compact", action="store_true",
+                        help="rewrite in place: collapse progress chains, "
+                             "drop finished requests")
+    parser.add_argument("--keep-finished", action="store_true",
+                        help="with --compact: keep finished requests' "
+                             "terminal records")
+    args = parser.parse_args(argv)
+    try:
+        report = fsck(args.path, compact=args.compact,
+                      keep_finished=args.keep_finished)
+    except (JournalError, OSError) as exc:
+        print(json.dumps({"path": args.path, "error": str(exc)}), flush=True)
+        return 2
+    print(json.dumps(report), flush=True)
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
