@@ -22,9 +22,11 @@ from .request import (
     Request,
     RequestOutput,
     SamplingParams,
+    SLOSpec,
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .trace import NULL_TRACER, NullTracer, TraceEvent, Tracer
 
 __all__ = [
     "ServingEngine",
@@ -41,7 +43,12 @@ __all__ = [
     "Request",
     "RequestOutput",
     "SamplingParams",
+    "SLOSpec",
     "SubmitResult",
+    "Tracer",
+    "NullTracer",
+    "NULL_TRACER",
+    "TraceEvent",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_ABORTED",

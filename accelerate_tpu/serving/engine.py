@@ -93,6 +93,17 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .trace import (
+    EV_ADMIT,
+    EV_DISPATCH,
+    EV_FETCH,
+    EV_FINISH,
+    EV_QUARANTINE,
+    EV_REJECT,
+    EV_SUBMIT,
+    NULL_TRACER,
+    nearest_rank,
+)
 
 
 def _sample_slot(logits: jax.Array, key: jax.Array, temperature: jax.Array,
@@ -130,6 +141,9 @@ class _Inflight:
     arrays: tuple
     slots: tuple[int, ...]
     gens: tuple[int, ...]
+    # trace pairing handle (serving/trace.py): the EV_DISPATCH sequence number
+    # this entry was stamped with, echoed by its EV_FETCH; -1 when untraced
+    seq: int = -1
 
 
 # engine snapshot file format tag (docs/reliability.md "Serving recovery"):
@@ -207,6 +221,13 @@ class ServingEngine:
     all-reduce every N steps into ``metrics.collective_s`` (benches only —
     the block serializes the dispatch pipeline).
 
+    ``tracer=`` attaches a `serving.trace.Tracer`: every request lifecycle
+    edge and every jitted dispatch/fetch pair is recorded as a span event,
+    exportable to Perfetto via ``tracer.export(path)`` and summarized by
+    ``tools/trace_report.py`` (`docs/observability.md`). Default: no tracer,
+    zero overhead. Requests carrying a `request.SLOSpec` additionally feed
+    `ServingMetrics.goodput()` attainment accounting at retirement.
+
     Typical loop::
 
         engine = ServingEngine(module, params, max_concurrency=8)
@@ -237,6 +258,7 @@ class ServingEngine:
         param_rules: Any = None,
         collective_probe_every: int = 0,
         journal: Any = None,
+        tracer: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -347,6 +369,16 @@ class ServingEngine:
         self.metrics = metrics or ServingMetrics()
         self.tracker = tracker
         self.metrics_log_every = int(metrics_log_every)
+        # request-level tracing (serving/trace.py, docs/observability.md):
+        # ``tracer=`` takes a `trace.Tracer`; the default NULL_TRACER keeps
+        # every emission site a single attribute check — zero-overhead off.
+        # The scheduler shares the tracer so QUEUED edges are stamped where
+        # the queue actually changes.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.scheduler.tracer = self.tracer
+        # (key, compiled, wall_s) of the most recent jitted dispatch — the
+        # compile-vs-replay flag EV_DISPATCH events carry
+        self._last_dispatch: tuple[str, bool, float] = ("", False, 0.0)
 
         b = self.max_concurrency
         # device state: the slot-pool cache (donated through every step) plus
@@ -406,6 +438,10 @@ class ServingEngine:
         self._slot_req: list[Request | None] = [None] * b
         self._slot_out: list[RequestOutput | None] = [None] * b
         self._slot_last_token_t = [0.0] * b
+        # per-request inter-token gaps, collected ONLY while the slot's tenant
+        # carries an SLO with an ITL bound (None otherwise — the common path
+        # appends nothing); retired into per-class attainment via observe_slo
+        self._slot_itl: list[list[float] | None] = [None] * b
         self._free: deque[int] = deque(range(b))
         self._inflight: deque[_Inflight] = deque()
         self._next_id = 0
@@ -504,14 +540,44 @@ class ServingEngine:
 
     def _dispatch(self, key: str, fn, *args):
         """Call a jitted serving program, recording the first dispatch per key
-        as one compile (count + wall seconds) in the metrics."""
-        if key in self._compile_seen:
+        as one compile (count + wall seconds) in the metrics. With a tracer
+        attached the call is additionally timed for the EV_DISPATCH
+        compile-vs-replay flag and (optionally) wrapped in a
+        ``jax.profiler.TraceAnnotation`` so the host span lines up with
+        device traces; with the default NULL_TRACER a replay dispatch is the
+        bare ``fn(*args)`` it always was."""
+        compiled = key not in self._compile_seen
+        if not compiled and not self.tracer.enabled:
             return fn(*args)
         t0 = time.perf_counter()
-        out = fn(*args)
-        self._compile_seen.add(key)
-        self.metrics.record_compile(key, time.perf_counter() - t0)
+        with self.tracer.annotation(key):
+            out = fn(*args)
+        dt = time.perf_counter() - t0
+        if compiled:
+            self._compile_seen.add(key)
+            self.metrics.record_compile(key, dt)
+        self._last_dispatch = (key, compiled, dt)
         return out
+
+    def _trace_dispatch(self, entry: _Inflight, what: str) -> None:
+        """Stamp a just-enqueued `_Inflight` with a dispatch sequence number
+        and emit its EV_DISPATCH span: which jitted program ran (compile or
+        replay), the pipeline depth it joined at, and every (slot, rid, gen)
+        riding it — the handle `trace.validate` balances against EV_FETCH."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        entry.seq = tr.next_seq()
+        key, compiled, dt = self._last_dispatch
+        reqs = tuple(
+            (int(slot), self._slot_req[slot].request_id, int(gen))
+            for slot, gen in zip(entry.slots, entry.gens)
+            if self._active[slot] and self._slot_req[slot] is not None
+            and self._slot_gen[slot] == gen
+        )
+        tr.emit(EV_DISPATCH, None, seq=entry.seq, what=what, key=key,
+                compiled=compiled, dispatch_s=round(dt, 6),
+                depth=len(self._inflight), step=self._step_count, reqs=reqs)
 
     # ------------------------------------------------------------- jitted fns
     def _build_step_fn(self):
@@ -727,11 +793,20 @@ class ServingEngine:
         if request.arrival_time is None:
             request.arrival_time = time.perf_counter()
         self.metrics.mark_start()
+        tr = self.tracer
+        if tr.enabled:
+            tr.emit(EV_SUBMIT, request.request_id,
+                    prompt_len=len(request.prompt),
+                    slo=request.slo.name if request.slo is not None else None)
         if self._draining:
             self.metrics.requests_rejected.inc()
+            if tr.enabled:
+                tr.emit(EV_REJECT, request.request_id, reason=REJECT_DRAINING)
             return SubmitResult(False, request.request_id, REJECT_DRAINING,
                                 "engine is draining toward shutdown")
         result = self.scheduler.submit(request)
+        if not result.accepted and tr.enabled:
+            tr.emit(EV_REJECT, request.request_id, reason=result.reason)
         if result.accepted:
             # WRITE-AHEAD: the acceptance is durable before the caller sees
             # it — a crash after this line can lose the reply, never the
@@ -784,10 +859,12 @@ class ServingEngine:
             )
             self._d_tokens, self._d_finished = nxt, fin
             self.metrics.dispatch_depth.observe(len(self._inflight) + 1)
-            self._inflight.append(_Inflight(
+            entry = _Inflight(
                 "step", (nxt, fin, ok),
                 tuple(range(self.max_concurrency)), tuple(self._slot_gen),
-            ))
+            )
+            self._inflight.append(entry)
+            self._trace_dispatch(entry, "step")
             if (self._probe_fn is not None
                     and self._step_count % self.collective_probe_every == 0):
                 t0 = time.perf_counter()
@@ -859,6 +936,10 @@ class ServingEngine:
         queued = self.scheduler.cancel(request_id)
         if queued is not None:
             self.metrics.requests_cancelled.inc()
+            self._slo_never_served(queued)
+            if self.tracer.enabled:
+                self.tracer.emit(EV_FINISH, request_id, reason=FINISH_ABORTED,
+                                 tokens=0, depth=len(self._inflight))
             if self.journal is not None:
                 self.journal.log_finish(request_id, FINISH_ABORTED, [])
             return RequestOutput(
@@ -922,6 +1003,11 @@ class ServingEngine:
         aborted: list[RequestOutput] = []
         for req in self.scheduler.drain_queue():
             self.metrics.requests_cancelled.inc()
+            self._slo_never_served(req)
+            if self.tracer.enabled:
+                self.tracer.emit(EV_FINISH, req.request_id,
+                                 reason=FINISH_ABORTED,
+                                 tokens=len(req.resume_tokens), depth=0)
             if self.journal is not None:
                 self.journal.log_finish(req.request_id, FINISH_ABORTED,
                                         list(req.resume_tokens))
@@ -934,6 +1020,13 @@ class ServingEngine:
         for slot in np.flatnonzero(self._active):
             self.metrics.requests_cancelled.inc()
             self._retire(int(slot), FINISH_ABORTED, now, aborted)
+        if self.tracer.enabled:
+            # the cleared entries are never fetched — emit their EV_FETCH as
+            # discarded so dispatch/fetch stays balanced in the trace
+            for i, entry in enumerate(self._inflight):
+                self.tracer.emit(EV_FETCH, None, seq=entry.seq,
+                                 what=entry.kind, discarded=True,
+                                 depth=len(self._inflight) - i - 1)
         self._inflight.clear()  # every entry now predates a generation bump
         return aborted
 
@@ -1119,6 +1212,11 @@ class ServingEngine:
                 out = RequestOutput(request_id=rid, prompt_len=plen,
                                     tokens=toks, finish_reason=done_reason)
                 report.completed[rid] = out
+                if self.tracer.enabled:
+                    self.tracer.emit(EV_SUBMIT, rid, prompt_len=plen,
+                                     recovered=True)
+                    self.tracer.emit(EV_FINISH, rid, reason=done_reason,
+                                     tokens=len(toks), depth=0)
                 if self.journal is not None:
                     if foreign:
                         req = Request(prompt=prompt, params=sp, request_id=rid)
@@ -1136,6 +1234,11 @@ class ServingEngine:
                     finish_time=perf_now,
                 )
                 report.expired.append(out)
+                if self.tracer.enabled:
+                    self.tracer.emit(EV_SUBMIT, rid, prompt_len=plen,
+                                     recovered=True)
+                    self.tracer.emit(EV_REJECT, rid, reason=REJECT_DEADLINE,
+                                     expired=True)
                 if self.journal is not None:
                     if foreign:
                         req = Request(prompt=prompt, params=sp, request_id=rid,
@@ -1159,6 +1262,9 @@ class ServingEngine:
                 resume_tokens=toks[:keep],
                 arrival_time=perf_now - waited,
             )
+            if self.tracer.enabled:
+                self.tracer.emit(EV_SUBMIT, rid, prompt_len=plen,
+                                 recovered=True, resumed=len(request.resume_tokens))
             result = self.scheduler.submit(request)
             if not result.accepted:
                 raise RuntimeError(
@@ -1229,7 +1335,12 @@ class ServingEngine:
         entry = self._inflight.popleft()
         blocked_t = time.perf_counter()
         fetched = jax.device_get(entry.arrays)
-        self.metrics.host_blocked_s.observe(time.perf_counter() - blocked_t)
+        blocked = time.perf_counter() - blocked_t
+        self.metrics.host_blocked_s.observe(blocked)
+        if self.tracer.enabled:
+            self.tracer.emit(EV_FETCH, None, seq=entry.seq, what=entry.kind,
+                             blocked_s=round(blocked, 6),
+                             depth=len(self._inflight))
         now = time.perf_counter()
         if entry.kind == "admit":
             self._process_admit(entry, fetched, now, finished)
@@ -1282,7 +1393,10 @@ class ServingEngine:
             out = self._slot_out[slot]
             out.tokens.append(token)
             self.metrics.tokens_generated.inc()
-            self.metrics.inter_token_s.observe(now - self._slot_last_token_t[slot])
+            gap = now - self._slot_last_token_t[slot]
+            self.metrics.inter_token_s.observe(gap)
+            if self._slot_itl[slot] is not None:
+                self._slot_itl[slot].append(gap)
             self._slot_last_token_t[slot] = now
             if (self.journal is not None
                     and len(out.tokens) - self._slot_logged[slot]
@@ -1311,6 +1425,11 @@ class ServingEngine:
         is token-identical to an unpoisoned run). Second offence: retire with
         `FINISH_ERROR`, keeping the engine serving healthy slots."""
         request = self._slot_req[slot]
+        if self.tracer.enabled:
+            self.tracer.emit(EV_QUARANTINE, request.request_id, slot=slot,
+                             gen=int(self._slot_gen[slot]),
+                             retry=request.retries,
+                             depth=len(self._inflight))
         if request.retries == 0:
             request.retries += 1
             self.metrics.requests_retried.inc()
@@ -1325,6 +1444,10 @@ class ServingEngine:
             # expired while queued: reject rather than serve a reply the
             # client has already abandoned (REJECT_DEADLINE, never admitted)
             self.metrics.requests_expired.inc()
+            self._slo_never_served(request)
+            if self.tracer.enabled:
+                self.tracer.emit(EV_REJECT, request.request_id,
+                                 reason=REJECT_DEADLINE, expired=True)
             if self.journal is not None:
                 self.journal.log_finish(
                     request.request_id, f"rejected:{REJECT_DEADLINE}", []
@@ -1414,7 +1537,7 @@ class ServingEngine:
         )
         self.metrics.prefill_tokens.inc(int(lens.sum()))
         self.metrics.admit_batch_size.observe(nb)
-        self._finish_admit(group, None, slots, (first, fin0), finished)
+        self._finish_admit(group, None, slots, (first, fin0), finished, bucket)
 
     def _admit_group_cached(self, group: list[Request],
                             matches: list[PrefixMatch],
@@ -1484,11 +1607,13 @@ class ServingEngine:
         # only the uncached suffixes hit the model — that delta is the point
         self.metrics.prefill_tokens.inc(int(suffix_lens.sum()))
         self.metrics.admit_batch_size.observe(nb)
-        self._finish_admit(group, matches, slots, (first, fin0), finished)
+        self._finish_admit(group, matches, slots, (first, fin0), finished,
+                           bucket)
 
     def _finish_admit(self, group: list[Request],
                       matches: list[PrefixMatch] | None, slots: list[int],
-                      arrays: tuple, finished: list[RequestOutput]) -> None:
+                      arrays: tuple, finished: list[RequestOutput],
+                      bucket: int | None = None) -> None:
         gens = []
         for i, (slot, request) in enumerate(zip(slots, group)):
             self._slot_gen[slot] += 1
@@ -1505,28 +1630,82 @@ class ServingEngine:
             # tokens past it need (re-)journaling
             self._slot_logged[slot] = len(request.resume_tokens)
             self._active[slot] = True
+            slo = request.slo
+            self._slot_itl[slot] = (
+                [] if slo is not None and slo.itl_p99_s is not None else None
+            )
             if matches is not None:
                 m = matches[i]
                 # pins travel with the slot; released at retirement. The plain
                 # path leaves the _release_slot defaults (no match, miss).
                 self._slot_match[slot] = m if m.nodes else None
                 self._slot_hit[slot] = bool(m.tokens)
-        self._inflight.append(_Inflight(
-            "admit", arrays, tuple(slots), tuple(gens)
-        ))
+        entry = _Inflight("admit", arrays, tuple(slots), tuple(gens))
+        self._inflight.append(entry)
+        self._trace_dispatch(
+            entry, "cached_admit" if matches is not None else "admit"
+        )
+        if self.tracer.enabled:
+            for i, (slot, request) in enumerate(zip(slots, group)):
+                m = matches[i] if matches is not None else None
+                self.tracer.emit(
+                    EV_ADMIT, request.request_id, slot=slot, gen=gens[i],
+                    bucket=bucket, seq=entry.seq,
+                    cache_hit=bool(m.tokens) if m is not None else False,
+                    cached_tokens=m.tokens if m is not None else 0,
+                    resumed=len(request.resume_tokens),
+                    depth=len(self._inflight),
+                )
         # at depth 1 this fetches the first tokens NOW — an EOS or 1-token
         # budget frees its slot before the next group is sized, exactly
         # the pre-pipelining admission behavior
         self._drain_to(self.pipeline_depth - 1, finished)
 
+    def _slo_never_served(self, request: Request) -> None:
+        """SLO bookkeeping for an accepted request that terminates without
+        ever being admitted (queue-deadline expiry, queued cancel/abort): a
+        miss for its class — its TTFT bound, if any, was certainly blown."""
+        if request.slo is not None:
+            self.metrics.observe_slo(
+                request.slo, clean=False,
+                ttft_ok=request.slo.ttft_s is None, itl_ok=True, tokens=0,
+            )
+
     def _retire(self, slot: int, reason: str, now: float,
                 finished: list[RequestOutput]) -> None:
         out = self._slot_out[slot]
+        request = self._slot_req[slot]
         out.finish_reason = reason
         out.finish_time = now
         if out.arrival_time is not None:
             self.metrics.request_latency_s.observe(max(0.0, now - out.arrival_time))
         self.metrics.requests_finished.inc()
+        # SLO attainment (docs/observability.md): clean finishes only; the
+        # TTFT bound is judged on the host-observed first-token latency and
+        # the ITL bound on THIS request's own p99 decode gap (nearest-rank,
+        # same convention as the metrics histograms)
+        slo = request.slo
+        ttft_ok = itl_ok = True
+        if slo is not None:
+            if slo.ttft_s is not None:
+                ttft_ok = (
+                    out.first_token_time is not None
+                    and out.arrival_time is not None
+                    and out.first_token_time - out.arrival_time <= slo.ttft_s
+                )
+            gaps = self._slot_itl[slot]
+            if slo.itl_p99_s is not None and gaps:
+                itl_ok = nearest_rank(sorted(gaps), 0.99) <= slo.itl_p99_s
+        self.metrics.observe_slo(
+            slo, clean=reason in (FINISH_EOS, FINISH_LENGTH),
+            ttft_ok=ttft_ok, itl_ok=itl_ok,
+            tokens=len(out.tokens) - len(request.resume_tokens),
+        )
+        if self.tracer.enabled:
+            self.tracer.emit(EV_FINISH, out.request_id, slot=slot,
+                             gen=int(self._slot_gen[slot]), reason=reason,
+                             tokens=len(out.tokens),
+                             depth=len(self._inflight))
         if self.journal is not None:
             # the terminal record carries the whole stream: completed work is
             # parity-checkable and dedupable from the journal alone
@@ -1559,6 +1738,7 @@ class ServingEngine:
             self.prefix_cache.release(self._slot_match[slot])
         self._slot_match[slot] = None
         self._slot_hit[slot] = False
+        self._slot_itl[slot] = None
         self._slot_req[slot] = None
         self._slot_out[slot] = None
         self._active[slot] = False

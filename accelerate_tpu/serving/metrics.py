@@ -12,6 +12,8 @@ import math
 import time
 from typing import Any
 
+from .trace import nearest_rank
+
 
 class Counter:
     """Monotonic event count."""
@@ -68,11 +70,11 @@ class Histogram:
         return self.sum / self.count if self.count else 0.0
 
     def quantile(self, q: float) -> float:
-        if not self._samples:
-            return 0.0
-        ordered = sorted(self._samples)
-        idx = min(len(ordered) - 1, int(q * len(ordered)))
-        return ordered[idx]
+        """Nearest-rank quantile: ``ordered[ceil(q*n) - 1]`` (inverse CDF).
+        The obvious ``ordered[int(q*n)]`` is off by one — it returns the
+        element *above* the nearest rank, so p50 of two samples would report
+        the larger of the two."""
+        return nearest_rank(sorted(self._samples), q)
 
     def summary(self) -> dict[str, float]:
         if not self.count:
@@ -167,12 +169,93 @@ class ServingMetrics:
         self.slot_occupancy = Histogram()
         self.dispatch_depth = Histogram()
         self.admit_batch_size = Histogram()
+        # SLO / goodput accounting (docs/observability.md): tokens from
+        # requests that ATTAINED their SLO (requests without one attain
+        # vacuously on a clean finish), plus per-class attainment counters
+        # keyed by SLOSpec.name — {"requests", "attained", "ttft_miss",
+        # "itl_miss", "goodput_tokens"} per class
+        self.goodput_tokens = Counter()
+        self.slo_classes: dict[str, dict[str, int]] = {}
         self._start: float | None = None
+        # rate window: tokens_per_sec()/goodput() measure from the later of
+        # mark_start() and the last reset_rate_window(), so an engine that
+        # idles between bursts doesn't report a forever-decayed rate
+        self._win_t0: float | None = None
+        self._win_tokens = 0
+        self._win_goodput = 0
 
     def mark_start(self) -> None:
         """First-event clock for the aggregate tokens/sec rate."""
         if self._start is None:
             self._start = time.perf_counter()
+            self._win_t0 = self._start
+
+    def reset_rate_window(self) -> None:
+        """Start a fresh rate window: tokens_per_sec() and
+        goodput_tokens_per_sec count only tokens generated after this call.
+        Call between workload phases (bench harnesses do) — cumulative
+        counters and histograms are untouched."""
+        self._win_t0 = time.perf_counter()
+        self._win_tokens = self.tokens_generated.value
+        self._win_goodput = self.goodput_tokens.value
+
+    def observe_slo(
+        self,
+        slo: Any,
+        *,
+        clean: bool,
+        ttft_ok: bool,
+        itl_ok: bool,
+        tokens: int,
+    ) -> bool:
+        """Record one terminal request's SLO outcome; returns attainment.
+
+        ``slo`` is the request's `request.SLOSpec` or None (unconstrained —
+        attains iff the finish was clean, tracked under no class).
+        ``clean`` means FINISH_EOS/FINISH_LENGTH (expired / aborted /
+        errored requests are misses by definition); ``ttft_ok``/``itl_ok``
+        report each bound, and ``tokens`` is the request's generated-token
+        count, credited to goodput only on attainment.
+        """
+        attained = clean and ttft_ok and itl_ok
+        if slo is not None:
+            cls = self.slo_classes.setdefault(
+                slo.name,
+                {"requests": 0, "attained": 0, "ttft_miss": 0,
+                 "itl_miss": 0, "goodput_tokens": 0},
+            )
+            cls["requests"] += 1
+            cls["attained"] += int(attained)
+            cls["ttft_miss"] += int(not ttft_ok)
+            cls["itl_miss"] += int(not itl_ok)
+            cls["goodput_tokens"] += tokens if attained else 0
+        if attained:
+            self.goodput_tokens.inc(tokens)
+        return attained
+
+    def goodput(self) -> dict[str, Any]:
+        """SLO-goodput summary over the current rate window: goodput
+        tokens/sec (tokens from attaining requests), overall attainment
+        fraction across SLO-carrying requests (1.0 when none carried one),
+        and the per-class counter dicts."""
+        slo_requests = sum(c["requests"] for c in self.slo_classes.values())
+        slo_attained = sum(c["attained"] for c in self.slo_classes.values())
+        win = self._win_t0 if self._win_t0 is not None else self._start
+        dt = (time.perf_counter() - win) if win is not None else 0.0
+        gp_tokens = self.goodput_tokens.value - self._win_goodput
+        return {
+            "goodput_tokens": self.goodput_tokens.value,
+            "goodput_tokens_per_sec": gp_tokens / dt if dt > 0 else 0.0,
+            "slo_requests": slo_requests,
+            "slo_attainment": (slo_attained / slo_requests
+                               if slo_requests else 1.0),
+            "classes": {
+                name: {**stats,
+                       "attainment": (stats["attained"] / stats["requests"]
+                                      if stats["requests"] else 1.0)}
+                for name, stats in sorted(self.slo_classes.items())
+            },
+        }
 
     def observe_step(self, active: int, capacity: int, queue_depth: int) -> None:
         self.steps.inc()
@@ -193,10 +276,15 @@ class ServingMetrics:
         self.compiles[key] = round(float(seconds), 4)
 
     def tokens_per_sec(self) -> float:
+        """Aggregate decode rate over the current window (see
+        `reset_rate_window` — without resets this is the lifetime rate since
+        `mark_start`)."""
         if self._start is None:
             return 0.0
-        dt = time.perf_counter() - self._start
-        return self.tokens_generated.value / dt if dt > 0 else 0.0
+        win = self._win_t0 if self._win_t0 is not None else self._start
+        dt = time.perf_counter() - win
+        n = self.tokens_generated.value - self._win_tokens
+        return n / dt if dt > 0 else 0.0
 
     def snapshot(self) -> dict[str, Any]:
         """Flat scalar dict — the shape every tracker's ``log`` accepts."""
@@ -224,6 +312,14 @@ class ServingMetrics:
             "serving/tokens_per_sec": self.tokens_per_sec(),
             "serving/compile_count": self.compile_count.value,
         }
+        gp = self.goodput()
+        out["serving/goodput_tokens"] = gp["goodput_tokens"]
+        out["serving/goodput_tokens_per_sec"] = gp["goodput_tokens_per_sec"]
+        out["serving/slo_attainment"] = gp["slo_attainment"]
+        for name, stats in gp["classes"].items():
+            for stat in ("requests", "attained", "attainment",
+                         "ttft_miss", "itl_miss", "goodput_tokens"):
+                out[f"serving/slo/{name}/{stat}"] = stats[stat]
         for key, seconds in self.compiles.items():
             out[f"serving/compile/{key}"] = seconds
         for name, hist in (

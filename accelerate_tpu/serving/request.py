@@ -24,6 +24,28 @@ REJECT_DRAINING = "draining"  # engine is draining toward shutdown
 
 
 @dataclass(frozen=True)
+class SLOSpec:
+    """A latency service-level objective one request is served under
+    (`docs/observability.md` "SLO and goodput").
+
+    ``ttft_s`` bounds time-to-first-token (arrival → first generated token on
+    the host); ``itl_p99_s`` bounds the request's own p99 inter-token gap
+    (nearest-rank over its observed decode gaps). Either bound may be None
+    (unconstrained). ``name`` is the SLO *class* — per-class attainment
+    counters aggregate under it in `ServingMetrics.goodput()`.
+
+    A request **attains** its SLO iff it finishes cleanly (EOS or length —
+    aborted/errored/expired requests are misses by definition) and every set
+    bound holds. Tokens from attaining requests are *goodput*; the rest is
+    throughput the client gave up on.
+    """
+
+    ttft_s: float | None = None
+    itl_p99_s: float | None = None
+    name: str = "default"
+
+
+@dataclass(frozen=True)
 class SamplingParams:
     """Per-request decode settings (the `models/generation.generate` knobs plus
     a seed: temperature=0 is greedy, otherwise categorical with optional top-k;
@@ -54,6 +76,14 @@ class Request:
     the shared pool (`serving/prefix_cache.py` — opt out for privacy-scoped
     prompts or A/B measurement; tokens are identical either way).
 
+    ``slo`` optionally attaches an `SLOSpec`: the engine evaluates TTFT /
+    per-request ITL-p99 bounds at retirement and feeds the per-class
+    attainment + goodput counters in `metrics.ServingMetrics` (requests
+    without an SLO are unconstrained and always count as goodput). The SLO is
+    host-side accounting only — it never affects scheduling, and it is not
+    journaled (a restart re-serves the work; the client re-attaches its SLO
+    if it still cares).
+
     ``resume_tokens`` is the crash-recovery handle (`docs/reliability.md`
     "Serving recovery"): tokens this request had ALREADY emitted before an
     engine restart. Admission then prefills ``prompt + resume_tokens`` in one
@@ -70,6 +100,7 @@ class Request:
     deadline_s: float | None = None
     retries: int = 0
     cache_prefix: bool = True
+    slo: SLOSpec | None = None
     resume_tokens: list[int] = field(default_factory=list)
 
     @property

@@ -18,6 +18,7 @@ from .request import (
     Request,
     SubmitResult,
 )
+from .trace import EV_QUEUED, NULL_TRACER
 
 
 class FIFOScheduler:
@@ -44,6 +45,10 @@ class FIFOScheduler:
         # the compile cache stays bounded even though cached prefixes shrink
         # prompts by arbitrary block multiples.
         self.prefill_len_fn = None
+        # tracing hook (serving/trace.py): the engine points this at its
+        # tracer so every QUEUED edge — fresh acceptance or watchdog requeue —
+        # is stamped where the queue actually changes
+        self.tracer = NULL_TRACER
         self._queue: deque[Request] = deque()
 
     def bucket_for(self, prompt_len: int) -> int:
@@ -77,6 +82,10 @@ class FIFOScheduler:
                 f"{len(self._queue)} requests already queued",
             )
         self._queue.append(request)
+        if self.tracer.enabled:
+            self.tracer.emit(EV_QUEUED, request.request_id,
+                             queue_depth=len(self._queue),
+                             bucket=self.prefill_bucket_for(request))
         return SubmitResult(True, request.request_id)
 
     def next_ready(self) -> Request | None:
@@ -134,6 +143,11 @@ class FIFOScheduler:
         """Put a request at the FRONT of the queue (the watchdog's re-prefill
         path: a quarantined request must not wait behind new arrivals)."""
         self._queue.appendleft(request)
+        if self.tracer.enabled:
+            self.tracer.emit(EV_QUEUED, request.request_id,
+                             queue_depth=len(self._queue),
+                             bucket=self.prefill_bucket_for(request),
+                             requeued=True)
 
     def pop_expired(self, now: float) -> list[Request]:
         """Remove and return every queued request whose ``deadline_s`` queue

@@ -32,6 +32,17 @@ prompt prefill skipped via reuse; the PR-4 acceptance bar is >= 0.30),
 vs_baseline = tokens_per_sec(on) / tokens_per_sec(off), and detail splits
 TTFT p50/p99 by cache hit vs miss.
 
+Every traced request carries an `SLOSpec`: the short interactive replies get
+TTFT + ITL-p99 bounds (class "interactive"), the heavy-tail requests only
+need a clean finish (class "batch") — so each engine run's detail carries a
+goodput row (`docs/observability.md`): goodput_tokens_per_sec, overall SLO
+attainment, and per-class attainment fractions. ``BENCH_SERVE_TRACE=path``
+additionally attaches a `serving.Tracer` to the pipelined timed run and
+exports its Perfetto-loadable trace-event JSON there (summarize with
+``python tools/trace_report.py path``); the BENCH detail then carries the
+trace's event/drop/malformed counts. Tracing is off (the zero-overhead
+`NULL_TRACER`) unless the knob is set, so the headline numbers are untouched.
+
 Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_REQUESTS     trace length (default 32)
   BENCH_SERVE_CONCURRENCY  engine slots == lockstep batch size (default 8)
@@ -51,6 +62,8 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
                            seconds, compile stats) before the final summary
                            line; on CPU the needed virtual devices are forced
   BENCH_SERVE_PROBE_EVERY  mesh mode: collective-probe period in steps (1)
+  BENCH_SERVE_TRACE        path: export the pipelined timed run's trace-event
+                           JSON here (default: tracing off entirely)
 
 Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
 """
@@ -70,9 +83,22 @@ import numpy as np
 
 from accelerate_tpu.models.generation import generate
 from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
-from accelerate_tpu.serving import Request, SamplingParams, ServingEngine
+from accelerate_tpu.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    SLOSpec,
+    Tracer,
+)
 
 BUCKETS = (16, 32, 48)
+
+# SLO classes for the goodput row: short interactive replies carry latency
+# bounds (generous enough that a healthy warm engine attains them on the host
+# CPU — the row exists to surface regressions, not to fail by construction);
+# the heavy-tail batch requests only need to finish cleanly.
+SLO_INTERACTIVE = SLOSpec(ttft_s=30.0, itl_p99_s=5.0, name="interactive")
+SLO_BATCH = SLOSpec(name="batch")
 
 
 def _env_int(name: str, default: int) -> int:
@@ -94,11 +120,13 @@ def _trace(n: int, rate: float, seed: int, vocab: int) -> list[Request]:
             prompt=r.integers(0, vocab, (prompt_len,)).astype(np.int32).tolist(),
             params=SamplingParams(max_new_tokens=max_new),
             arrival_time=t,
+            slo=SLO_INTERACTIVE if short else SLO_BATCH,
         ))
     return reqs
 
 
 def _run_engine(engine, trace) -> tuple[float, float, dict]:
+    engine.metrics.reset_rate_window()  # this run's phase only
     t0 = time.perf_counter()
     pending = list(trace)
     done = 0
@@ -106,7 +134,7 @@ def _run_engine(engine, trace) -> tuple[float, float, dict]:
         now = time.perf_counter() - t0
         while pending and pending[0].arrival_time <= now:
             req = pending.pop(0)
-            engine.submit(Request(req.prompt, req.params))
+            engine.submit(Request(req.prompt, req.params, slo=req.slo))
         done += len(engine.step())
         if not engine.has_work and pending:
             # idle until the next arrival (sub-ms at a saturating rate)
@@ -116,6 +144,7 @@ def _run_engine(engine, trace) -> tuple[float, float, dict]:
     assert done == len(trace)
     m = engine.metrics
     steps = max(m.steps.value, 1)
+    gp = m.goodput()
     return tokens / dt, dt, {
         "ttft_p50_s": round(m.ttft_s.quantile(0.5), 4),
         "itl_p50_s": round(m.inter_token_s.quantile(0.5), 5),
@@ -126,6 +155,10 @@ def _run_engine(engine, trace) -> tuple[float, float, dict]:
         "host_blocked_per_step_s": round(m.host_blocked_s.sum / steps, 6),
         "slot_occupancy_mean": round(m.slot_occupancy.mean, 3),
         "steps": m.steps.value,
+        "goodput_tokens_per_sec": round(gp["goodput_tokens_per_sec"], 2),
+        "slo_attainment": round(gp["slo_attainment"], 4),
+        "slo_classes": {name: round(c["attainment"], 4)
+                        for name, c in gp["classes"].items()},
     }
 
 
@@ -376,18 +409,32 @@ def main() -> None:
 
     from accelerate_tpu.serving import ServingMetrics
 
-    def timed_engine(pipeline_depth):
+    def timed_engine(pipeline_depth, tracer=None):
         # warm pass on the SAME engine/jit caches: compile every (prompt,
         # batch) bucket and the decode step outside the timed region
         engine = ServingEngine(module, params, max_concurrency=concurrency,
                                prompt_buckets=BUCKETS, max_queue=len(trace) + 1,
-                               pipeline_depth=pipeline_depth, admit_batch=admit)
+                               pipeline_depth=pipeline_depth, admit_batch=admit,
+                               tracer=tracer)
         _run_engine(engine, trace)
         engine.metrics = ServingMetrics()  # drop the warm pass from the stats
+        if tracer is not None:
+            tracer.clear()  # the exported trace covers the timed window only
         return _run_engine(engine, trace)
 
+    tracer = Tracer() if os.environ.get("BENCH_SERVE_TRACE") else None
     sync_tps, sync_dt, sync_detail = timed_engine(1)
-    pipe_tps, pipe_dt, pipe_detail = timed_engine(depth)
+    pipe_tps, pipe_dt, pipe_detail = timed_engine(depth, tracer)
+    trace_summary = None
+    if tracer is not None:
+        exported = tracer.export(os.environ["BENCH_SERVE_TRACE"])
+        valid = tracer.validate()
+        trace_summary = {
+            "path": exported["path"],
+            "events": exported["events"],
+            "dropped": exported["dropped"],
+            "malformed_spans": len(valid["anomalies"]),
+        }
     # lockstep baseline (generate's jit cache is module-level and persists)
     _run_lockstep(module, params, trace, concurrency)
     lock_tps, lock_dt, lock_detail = _run_lockstep(module, params, trace, concurrency)
@@ -404,6 +451,10 @@ def main() -> None:
             "poisson_rate": rate,
             "pipeline_depth": depth,
             "admit_batch": admit,
+            "goodput_tokens_per_sec": pipe_detail["goodput_tokens_per_sec"],
+            "slo_attainment": pipe_detail["slo_attainment"],
+            "slo_classes": pipe_detail["slo_classes"],
+            "trace": trace_summary,
             "vs_depth1": round(pipe_tps / sync_tps, 3),
             "host_blocked_ratio_d2_over_d1": round(
                 pipe_detail["host_blocked_per_step_s"]

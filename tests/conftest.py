@@ -57,6 +57,12 @@ def pytest_configure(config):
         "snapshot/resume, preemption drain — docs/reliability.md \"Serving "
         "recovery\") — run standalone with `pytest -m recovery`",
     )
+    config.addinivalue_line(
+        "markers",
+        "trace: request-level tracing, Perfetto export, and SLO-goodput "
+        "tests (serving/trace.py — docs/observability.md) — run standalone "
+        "with `pytest -m trace`",
+    )
 
 
 @pytest.fixture

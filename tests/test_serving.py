@@ -577,7 +577,12 @@ def test_run_max_steps_aborts_leftovers_and_keeps_completed(model):
 def test_pipeline_depth_and_admit_batch_token_identical(model):
     """THE pipelining acceptance contract: every (pipeline_depth, admit_batch)
     combination emits bit-identical tokens — to each other AND to solo
-    generate — for a mixed greedy/sampled, ragged, oversubscribed workload."""
+    generate — for a mixed greedy/sampled, ragged, oversubscribed workload.
+    Every cell also runs with a `Tracer` attached and must emit a CLEAN
+    trace stream: one terminal per request, monotonic timestamps, balanced
+    dispatch/fetch (serving/trace.py invariants)."""
+    from accelerate_tpu.serving import Tracer
+
     module, params = model
     prompts = _prompts(20, [3, 7, 12, 5, 9, 4])
     specs = [
@@ -593,9 +598,11 @@ def test_pipeline_depth_and_admit_batch_token_identical(model):
            for p, n, sp in zip(prompts, budgets, specs)]
     for depth in (1, 2, 4):
         for admit in (1, 4):
+            tracer = Tracer()
             engine = ServingEngine(module, params, max_concurrency=3,
                                    prompt_buckets=(8, 16), max_queue=8,
-                                   pipeline_depth=depth, admit_batch=admit)
+                                   pipeline_depth=depth, admit_batch=admit,
+                                   tracer=tracer)
             outs = engine.run([
                 Request(p, SamplingParams(max_new_tokens=n, **sp))
                 for p, n, sp in zip(prompts, budgets, specs)
@@ -603,6 +610,11 @@ def test_pipeline_depth_and_admit_batch_token_identical(model):
             got = [o.tokens for o in sorted(outs, key=lambda o: o.request_id)]
             assert got == ref, f"pipeline_depth={depth} admit_batch={admit}"
             assert all(o.finish_reason == FINISH_LENGTH for o in outs)
+            valid = tracer.validate()
+            assert valid["clean"], (
+                f"pipeline_depth={depth} admit_batch={admit}: "
+                f"{valid['anomalies']}")
+            assert valid["requests"] == len(prompts)
     # pipelining telemetry exists and is sane: the depth-4 run dispatched
     # deeper than synchronous, every fetch was timed, and batched admission
     # grouped at least one multi-request prefill

@@ -214,7 +214,13 @@ def test_journal_fsck_reports_frontier_and_compacts(tmp_path):
 # ------------------------------------------------------- crash-exact resume
 def test_resume_from_journal_is_crash_exact(model, tmp_path):
     """Kill-and-resume via the journal: a fresh engine continues every
-    interrupted stream mid-flight, bit-for-bit — greedy and seeded sampling."""
+    interrupted stream mid-flight, bit-for-bit — greedy and seeded sampling.
+    The resuming engine runs with a `Tracer` attached: the crash-replay
+    stream (every surviving rid re-enters as EV_SUBMIT recovered=True) must
+    pass the same trace invariants as a fresh run."""
+    from accelerate_tpu.serving import Tracer
+    from accelerate_tpu.serving.trace import EV_SUBMIT, request_streams
+
     module, params = model
     jpath = tmp_path / "requests.journal"
     reqs = _mixed_requests(_prompts(0, (5, 9, 14, 7)), 12)
@@ -232,8 +238,9 @@ def test_resume_from_journal_is_crash_exact(model, tmp_path):
             pre[out.request_id] = out
     del a  # simulated SIGKILL: the fsync'd journal is all that survives
 
+    tracer = Tracer()
     b = ServingEngine(module, params, max_concurrency=2,
-                      prompt_buckets=(16,), journal=jpath)
+                      prompt_buckets=(16,), journal=jpath, tracer=tracer)
     report = b.resume()
     assert set(report.completed) == set(pre)  # dedup: finished never re-run
     assert set(report.resumed) | set(report.restored) == set(refs) - set(pre)
@@ -243,6 +250,18 @@ def test_resume_from_journal_is_crash_exact(model, tmp_path):
     assert {rid: o.tokens for rid, o in final.items()} == refs
     assert b.metrics.requests_resumed.value == len(report.resumed)
     assert b.metrics.replayed_tokens.value > 0
+    valid = tracer.validate()
+    assert valid["clean"], valid["anomalies"]
+    streams = request_streams(tracer.events())
+    # every rid the resume REPLAYED has a stream (journal-finished requests
+    # are dedup'd at scan time — never re-run, never re-traced), every stream
+    # opens with the recovery-flagged SUBMIT, and the mid-stream resumes
+    # carry their replayed prefix length
+    assert set(streams) == set(refs) - set(pre)
+    for rid, stream in streams.items():
+        assert stream[0].kind == EV_SUBMIT and stream[0].data.get("recovered")
+    for rid in report.resumed:
+        assert streams[rid][0].data["resumed"] > 0
 
 
 def test_resume_parity_with_prefix_cache_and_pipeline(model, tmp_path):

@@ -1,0 +1,311 @@
+"""Request-level tracing and SLO-goodput accounting (serving/trace.py,
+docs/observability.md): ring-buffer bounds, Chrome/Perfetto export shape,
+stream invariants, the nearest-rank quantile fix, rate-window resets, and
+per-class goodput attainment.
+
+The engine-integration side of the contract lives in test_serving.py (every
+cell of the depth x admit parity matrix must emit a clean trace) and
+test_serving_recovery.py (the invariants must hold across a crash + resume).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("flax.linen")
+
+pytestmark = [pytest.mark.serving, pytest.mark.trace]
+
+from accelerate_tpu.serving import (
+    NULL_TRACER,
+    NullTracer,
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingMetrics,
+    SLOSpec,
+    Tracer,
+)
+from accelerate_tpu.serving.metrics import Histogram
+from accelerate_tpu.serving.trace import (
+    EV_ADMIT,
+    EV_DISPATCH,
+    EV_FETCH,
+    EV_FINISH,
+    EV_QUEUED,
+    EV_SUBMIT,
+    load_exported,
+    nearest_rank,
+    request_streams,
+    validate,
+)
+
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    return module, params
+
+
+def _prompts(rng_seed, lengths, vocab=256):
+    r = np.random.default_rng(rng_seed)
+    return [r.integers(0, vocab, (n,)).astype(np.int32).tolist() for n in lengths]
+
+
+# ------------------------------------------------- nearest-rank quantile fix
+def test_histogram_quantile_nearest_rank_small_n():
+    """The off-by-one regression test: nearest-rank is ordered[ceil(q*n)-1].
+    The broken ordered[int(q*n)] returns the element ABOVE the rank — p50 of
+    two samples would report the larger one."""
+    h1 = Histogram()
+    h1.observe(5.0)
+    assert h1.quantile(0.5) == 5.0 and h1.quantile(0.99) == 5.0  # n=1
+
+    h2 = Histogram()
+    for v in (1.0, 2.0):
+        h2.observe(v)
+    assert h2.quantile(0.5) == 1.0  # n=2: rank ceil(1.0)-1 = 0, NOT index 1
+    assert h2.quantile(0.99) == 2.0
+    assert h2.quantile(0.01) == 1.0
+
+    h3 = Histogram()
+    for v in (3.0, 1.0, 2.0):
+        h3.observe(v)
+    assert h3.quantile(0.5) == 2.0  # n=3: the true median
+    assert h3.quantile(0.34) == 2.0  # ceil(1.02)-1 = 1
+    assert h3.quantile(0.33) == 1.0  # ceil(0.99)-1 = 0
+    assert h3.quantile(0.99) == 3.0
+
+
+def test_nearest_rank_clamps_degenerate_q():
+    assert nearest_rank([1.0, 2.0, 3.0], 0.0) == 1.0  # ceil(0)-1 clamps to 0
+    assert nearest_rank([1.0, 2.0, 3.0], 1.0) == 3.0
+    assert nearest_rank([], 0.5) == 0.0  # empty: 0.0, not an IndexError
+
+
+# ----------------------------------------------------------- rate windows
+def test_tokens_per_sec_rate_window_reset():
+    m = ServingMetrics()
+    m.mark_start()
+    m.tokens_generated.inc(100)
+    assert m.tokens_per_sec() > 0.0
+    m.reset_rate_window()
+    # only tokens generated AFTER the reset count toward the rate
+    assert m.tokens_per_sec() == 0.0
+    m.tokens_generated.inc(10)
+    rate = m.tokens_per_sec()
+    assert rate > 0.0
+    # cumulative counters are untouched by the window reset
+    assert m.tokens_generated.value == 110
+
+
+def test_goodput_rate_window_reset():
+    m = ServingMetrics()
+    m.mark_start()
+    m.observe_slo(SLOSpec(name="a"), clean=True, ttft_ok=True, itl_ok=True,
+                  tokens=50)
+    assert m.goodput()["goodput_tokens_per_sec"] > 0.0
+    m.reset_rate_window()
+    gp = m.goodput()
+    assert gp["goodput_tokens_per_sec"] == 0.0
+    assert gp["goodput_tokens"] == 50  # the cumulative counter survives
+
+
+# ------------------------------------------------------------ tracer core
+def test_tracer_ring_buffer_bounded_with_drop_counter():
+    t = Tracer(capacity=4)
+    for i in range(10):
+        t.emit(EV_SUBMIT, i, prompt_len=1)
+    events = t.events()
+    assert len(events) == 4
+    assert t.dropped == 6
+    assert [ev.rid for ev in events] == [6, 7, 8, 9]  # oldest dropped first
+    valid = t.validate()
+    assert valid["truncated"] is True
+    # a truncated stream skips completeness checks (heads were dropped) but
+    # still reports counts
+    assert valid["events"] == 4 and valid["dropped"] == 6
+
+
+def test_tracer_deterministic_no_rng_monotonic_ts():
+    calls = []
+
+    def clock():
+        calls.append(len(calls))
+        return float(len(calls))  # strictly increasing fake monotonic clock
+
+    t = Tracer(clock=clock)
+    t.emit(EV_SUBMIT, 0, prompt_len=2)
+    t.emit(EV_QUEUED, 0, queue_depth=1, bucket=8)
+    ts = [ev.ts for ev in t.events()]
+    assert ts == sorted(ts) and len(set(ts)) == len(ts)
+
+
+def test_null_tracer_is_default_and_inert(model):
+    module, params = model
+    engine = ServingEngine(module, params, max_concurrency=1,
+                           prompt_buckets=(8,))
+    assert engine.tracer is NULL_TRACER
+    assert isinstance(engine.tracer, NullTracer)
+    assert NULL_TRACER.enabled is False
+    NULL_TRACER.emit(EV_SUBMIT, 0)  # no-op, no storage
+    assert NULL_TRACER.events() == []
+    with pytest.raises(RuntimeError):
+        NULL_TRACER.export("/dev/null")
+
+
+def test_chrome_export_loads_in_trace_event_format(model, tmp_path):
+    """The exported JSON is valid Chrome trace-event format (what Perfetto's
+    legacy loader accepts): a traceEvents list whose entries carry name/ph/ts,
+    with our raw stream riding under accelerateTpuTrace."""
+    module, params = model
+    tracer = Tracer()
+    engine = ServingEngine(module, params, max_concurrency=2,
+                           prompt_buckets=(8,), pipeline_depth=2,
+                           tracer=tracer)
+    engine.run([Request(p, SamplingParams(max_new_tokens=3))
+                for p in _prompts(3, [4, 6, 5])])
+    path = tmp_path / "trace.json"
+    summary = tracer.export(path)
+    assert summary["events"] == len(tracer.events())
+    doc = json.loads(path.read_text())
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for entry in doc["traceEvents"]:
+        assert entry["ph"] in ("M", "X", "i", "b", "e")
+        if entry["ph"] != "M":
+            assert entry["ts"] >= 0
+        if entry["ph"] == "X":
+            assert entry["dur"] >= 0
+        if entry["ph"] in ("b", "e"):
+            assert "id" in entry  # async dispatch spans pair begin/end by id
+    # round-trip: the embedded raw stream revalidates clean and matches
+    events, dropped = load_exported(doc)
+    assert dropped == 0
+    assert validate(events)["clean"]
+    assert len(events) == len(tracer.events())
+    assert request_streams(events).keys() == {0, 1, 2}
+
+
+def test_validate_flags_malformed_streams():
+    t = Tracer()
+    t.emit(EV_SUBMIT, 0, prompt_len=2)  # never terminates
+    valid = t.validate()
+    assert not valid["clean"]
+    assert any("terminal" in a for a in valid["anomalies"])
+
+    t2 = Tracer()
+    t2.emit(EV_FINISH, 1, reason="length", tokens=3)  # stream with no SUBMIT
+    assert not t2.validate()["clean"]
+
+    t3 = Tracer()
+    seq = t3.next_seq()
+    t3.emit(EV_DISPATCH, None, seq=seq, what="step", key="k", compiled=False,
+            dispatch_s=0.0, depth=1, step=0, reqs=())
+    t3.emit(EV_FETCH, None, seq=seq + 7, what="step", blocked_s=0.0, depth=0)
+    assert not t3.validate()["clean"]  # fetch of a seq never dispatched
+
+
+def test_trace_engine_stream_shape(model):
+    """One engine request end-to-end: SUBMIT -> QUEUED -> ADMIT (slot, gen,
+    bucket) -> FINISH, and the admit's seq pairs with a dispatch/fetch."""
+    module, params = model
+    tracer = Tracer()
+    engine = ServingEngine(module, params, max_concurrency=1,
+                           prompt_buckets=(8,), tracer=tracer)
+    engine.run([Request(_prompts(5, [4])[0],
+                        SamplingParams(max_new_tokens=2))])
+    stream = request_streams(tracer.events())[0]
+    kinds = [ev.kind for ev in stream]
+    assert kinds[0] == EV_SUBMIT
+    assert EV_QUEUED in kinds and EV_ADMIT in kinds
+    assert kinds[-1] == EV_FINISH
+    admit = next(ev for ev in stream if ev.kind == EV_ADMIT)
+    assert admit.data["slot"] == 0 and admit.data["bucket"] == 8
+    assert "gen" in admit.data
+    fetches = {ev.data["seq"] for ev in tracer.events()
+               if ev.kind == EV_FETCH}
+    assert admit.data["seq"] in fetches
+    finish = stream[-1]
+    assert finish.data["reason"] == "length" and finish.data["tokens"] > 0
+
+
+# ------------------------------------------------------------- SLO/goodput
+def test_slo_attainment_and_goodput(model):
+    """Three SLO classes through a live engine: an attainable one, one with
+    an impossible TTFT bound (misses), and an unconstrained request (credited
+    to goodput but no class row)."""
+    module, params = model
+    engine = ServingEngine(module, params, max_concurrency=2,
+                           prompt_buckets=(8,))
+    prompts = _prompts(9, [4, 5, 6])
+    engine.run([
+        Request(prompts[0], SamplingParams(max_new_tokens=3),
+                slo=SLOSpec(ttft_s=300.0, itl_p99_s=300.0, name="easy")),
+        Request(prompts[1], SamplingParams(max_new_tokens=3),
+                slo=SLOSpec(ttft_s=0.0, name="impossible")),
+        Request(prompts[2], SamplingParams(max_new_tokens=3)),
+    ])
+    gp = engine.metrics.goodput()
+    assert gp["slo_requests"] == 2  # the unconstrained request has no class
+    assert gp["classes"]["easy"]["attained"] == 1
+    assert gp["classes"]["easy"]["attainment"] == 1.0
+    assert gp["classes"]["impossible"]["attained"] == 0
+    assert gp["classes"]["impossible"]["ttft_miss"] == 1
+    assert gp["slo_attainment"] == 0.5
+    # goodput tokens: the attaining request's 3 + the unconstrained clean
+    # finisher's 3; the TTFT-missing request's tokens are throughput, not
+    # goodput
+    assert gp["goodput_tokens"] == 6
+    assert engine.metrics.tokens_generated.value == 9
+    snap = engine.metrics.snapshot()
+    assert snap["serving/slo/impossible/attainment"] == 0.0
+    assert snap["serving/goodput_tokens"] == 6
+    assert all(np.isfinite(v) for v in snap.values()
+               if isinstance(v, (int, float)))
+
+
+def test_slo_itl_bound_uses_per_request_p99(model):
+    """An ITL-only SLO collects per-request gaps and judges their nearest-rank
+    p99: a generous bound attains, an impossible one records an itl_miss."""
+    module, params = model
+    engine = ServingEngine(module, params, max_concurrency=1,
+                           prompt_buckets=(8,))
+    p = _prompts(11, [4])[0]
+    engine.run([Request(p, SamplingParams(max_new_tokens=4),
+                        slo=SLOSpec(itl_p99_s=300.0, name="loose"))])
+    engine.run([Request(p, SamplingParams(max_new_tokens=4),
+                        slo=SLOSpec(itl_p99_s=0.0, name="tight"))])
+    gp = engine.metrics.goodput()
+    assert gp["classes"]["loose"]["attained"] == 1
+    assert gp["classes"]["loose"]["itl_miss"] == 0
+    assert gp["classes"]["tight"]["attained"] == 0
+    assert gp["classes"]["tight"]["itl_miss"] == 1
+
+
+def test_slo_never_served_counts_as_miss(model):
+    """A queued request cancelled before any token: its class records the
+    request and the miss — accepted work that never serves is an SLO failure,
+    not a statistics hole."""
+    module, params = model
+    engine = ServingEngine(module, params, max_concurrency=1,
+                           prompt_buckets=(8,), max_queue=4)
+    prompts = _prompts(13, [4, 5])
+    r0 = engine.submit(Request(prompts[0], SamplingParams(max_new_tokens=2),
+                               slo=SLOSpec(name="held")))
+    r1 = engine.submit(Request(prompts[1], SamplingParams(max_new_tokens=2),
+                               slo=SLOSpec(ttft_s=60.0, name="held")))
+    assert r0.accepted and r1.accepted
+    assert engine.cancel(r1.request_id)  # still queued: never served
+    while engine.has_work:
+        engine.step()
+    cls = engine.metrics.goodput()["classes"]["held"]
+    assert cls["requests"] == 2
+    assert cls["attained"] == 1  # the served one
+    assert cls["ttft_miss"] == 1  # the cancelled one had a TTFT bound

@@ -1,0 +1,152 @@
+"""End-to-end smoke tests for the repo's file-inspection CLIs —
+`tools/trace_report.py` and `tools/journal_fsck.py` — run as real
+subprocesses against generated fixtures, asserting the exit-code contract
+each tool documents:
+
+    0  the file parsed and is clean
+    1  the file parsed but carries anomalies (malformed spans / mid-file
+       journal corruption)
+    2  not a file of that type at all (unreadable / wrong format)
+
+Exit codes are the scripting interface (CI gates pipe these tools); a drift
+here breaks callers silently, which is why the contract gets its own suite.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.trace]
+
+from accelerate_tpu.serving import (
+    FINISH_LENGTH,
+    Request,
+    RequestJournal,
+    SamplingParams,
+    Tracer,
+)
+from accelerate_tpu.serving.trace import (
+    EV_ADMIT,
+    EV_DISPATCH,
+    EV_FETCH,
+    EV_FINISH,
+    EV_QUEUED,
+    EV_SUBMIT,
+)
+
+_REPO = Path(__file__).resolve().parent.parent
+_TRACE_REPORT = _REPO / "tools" / "trace_report.py"
+_JOURNAL_FSCK = _REPO / "tools" / "journal_fsck.py"
+
+
+def _run(tool: Path, *args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(tool), *map(str, args)],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def _clean_trace(path: Path) -> None:
+    """A minimal valid stream, emitted the way the engine does: one request
+    admitted on dispatch seq 0, one decode step on seq 1, both fetched,
+    terminal FINISH last."""
+    t = Tracer()
+    t.emit(EV_SUBMIT, 0, prompt_len=4, slo=None)
+    t.emit(EV_QUEUED, 0, queue_depth=1, bucket=8)
+    s0 = t.next_seq()
+    t.emit(EV_DISPATCH, None, seq=s0, what="admit", key="admit[pb8b1]",
+           compiled=True, dispatch_s=0.01, depth=1, step=0,
+           reqs=((0, 0, 0),))
+    t.emit(EV_ADMIT, 0, slot=0, gen=0, bucket=8, seq=s0, cache_hit=False,
+           cached_tokens=0, resumed=0, depth=1)
+    t.emit(EV_FETCH, None, seq=s0, what="admit", blocked_s=0.001, depth=0)
+    s1 = t.next_seq()
+    t.emit(EV_DISPATCH, None, seq=s1, what="step", key="step@mesh1x1",
+           compiled=True, dispatch_s=0.01, depth=1, step=1,
+           reqs=((0, 0, 0),))
+    t.emit(EV_FETCH, None, seq=s1, what="step", blocked_s=0.001, depth=0)
+    t.emit(EV_FINISH, 0, slot=0, gen=0, reason=FINISH_LENGTH, tokens=2,
+           depth=0)
+    assert t.validate()["clean"]  # fixture sanity: the CLI must agree
+    t.export(path)
+
+
+# ------------------------------------------------------------ trace_report
+def test_trace_report_exit_0_on_clean_trace(tmp_path):
+    path = tmp_path / "clean.trace.json"
+    _clean_trace(path)
+    proc = _run(_TRACE_REPORT, path)
+    assert proc.returncode == 0, proc.stderr
+    assert "malformed_spans=0" in proc.stdout
+    assert "per-phase latency breakdown" in proc.stdout
+    # --json mode emits one parseable document with the same verdict
+    proc = _run(_TRACE_REPORT, path, "--json")
+    assert proc.returncode == 0
+    rep = json.loads(proc.stdout)
+    assert rep["clean"] is True and rep["requests"] == 1
+    assert rep["phases"]["total"]["count"] == 1
+
+
+def test_trace_report_exit_1_on_malformed_spans(tmp_path):
+    t = Tracer()
+    t.emit(EV_SUBMIT, 0, prompt_len=4)
+    t.emit(EV_QUEUED, 0, queue_depth=1, bucket=8)  # never reaches a terminal
+    path = tmp_path / "anomalous.trace.json"
+    t.export(path)
+    proc = _run(_TRACE_REPORT, path)
+    assert proc.returncode == 1, proc.stdout
+    assert "ANOMALY" in proc.stdout
+
+
+def test_trace_report_exit_2_on_non_trace_file(tmp_path):
+    not_json = tmp_path / "garbage.bin"
+    not_json.write_bytes(b"\x00\x01 definitely not json")
+    proc = _run(_TRACE_REPORT, not_json)
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["error"]
+
+    # valid Chrome-trace JSON but not OUR export (no embedded raw stream):
+    # the tool cannot re-validate it, and says so rather than guessing
+    foreign = tmp_path / "foreign.trace.json"
+    foreign.write_text(json.dumps({"traceEvents": []}))
+    assert _run(_TRACE_REPORT, foreign).returncode == 2
+
+    missing = tmp_path / "does_not_exist.json"
+    assert _run(_TRACE_REPORT, missing).returncode == 2
+
+
+# ------------------------------------------------------------ journal_fsck
+def test_journal_fsck_exit_0_on_clean_journal(tmp_path):
+    path = tmp_path / "clean.journal"
+    with RequestJournal(path) as j:
+        j.log_submit(Request([1, 2, 3], SamplingParams(max_new_tokens=4),
+                             request_id=0))
+        j.log_first_token(0, 7, 1)
+        j.log_finish(0, FINISH_LENGTH, [7, 8])
+    proc = _run(_JOURNAL_FSCK, path)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["clean"] is True and report["anomalies"] == 0
+
+
+def test_journal_fsck_exit_1_on_anomalous_journal(tmp_path):
+    path = tmp_path / "anomalous.journal"
+    with RequestJournal(path) as j:
+        # FIRST_TOKEN for a rid that was never submitted: a mid-file
+        # ordering violation, not a torn tail
+        j.log_first_token(99, 7, 1)
+    proc = _run(_JOURNAL_FSCK, path)
+    assert proc.returncode == 1, proc.stdout
+    report = json.loads(proc.stdout)
+    assert report["clean"] is False and report["anomalies"] >= 1
+
+
+def test_journal_fsck_exit_2_on_non_journal_file(tmp_path):
+    path = tmp_path / "not_a_journal"
+    path.write_bytes(b"definitely not a journal")
+    proc = _run(_JOURNAL_FSCK, path)
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["error"]

@@ -68,6 +68,20 @@ Env knobs:
   CHAOS_GRACE           sigterm scenario: the child handler's drain grace
                         window, seconds (default 0.05 — small on purpose, so
                         work REMAINS and the snapshot path is exercised)
+  CHAOS_TRACE           path: attach a `serving.Tracer` to the replay engine
+                        (the RESUMING engine under a crash scenario), export
+                        its Perfetto-loadable trace-event JSON here, and
+                        assert the stream passes the trace invariants —
+                        exactly one terminal per request, balanced
+                        dispatch/fetch — even under quarantine/expiry/crash
+                        churn (summarize with tools/trace_report.py).
+                        Default: tracing off (the zero-overhead NULL_TRACER)
+
+Every replayed request also carries an `SLOSpec` (class "deadline" for the
+tight-deadline victims, "plain" otherwise; no latency bounds — attainment
+under chaos means "finished cleanly"), so the summary detail carries a
+goodput row: watchdog FINISH_ERRORs and deadline expiries surface as
+per-class attainment misses (`docs/observability.md`).
 """
 
 from __future__ import annotations
@@ -101,6 +115,7 @@ def run(
     prefix_blocks: int = 6,
     verify_parity: bool = True,
     mesh=None,
+    trace_path: str | None = None,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     (with ``verify_parity``) zero token drift against solo generate; return
@@ -118,6 +133,8 @@ def run(
         PrefixCacheConfig,
         Request,
         ServingEngine,
+        SLOSpec,
+        Tracer,
     )
 
     if module is None:
@@ -146,6 +163,7 @@ def run(
             slots=(0,),
         ))
     injector = FaultInjector(seed=seed, specs=specs)
+    tracer = Tracer() if trace_path else None
     engine = ServingEngine(
         module, params, max_concurrency=concurrency,
         prompt_buckets=BUCKETS, max_queue=n_requests + 1,
@@ -153,7 +171,10 @@ def run(
         prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
                       if prefix_cache else False),
         mesh=mesh,
+        tracer=tracer,
     )
+    slo_plain = SLOSpec(name="plain")
+    slo_deadline = SLOSpec(name="deadline")
 
     submitted: dict[int, str] = {}
     terminal: dict[int, str] = {}
@@ -171,6 +192,7 @@ def run(
                 result = engine.submit(Request(
                     src.prompt, src.params,
                     deadline_s=deadline_s if tight else None,
+                    slo=slo_deadline if tight else slo_plain,
                 ))
                 submitted[result.request_id] = "deadline" if tight else "plain"
                 req_by_id[result.request_id] = src
@@ -212,6 +234,18 @@ def run(
     for reason in terminal.values():
         reasons[reason] = reasons.get(reason, 0) + 1
     m = engine.metrics
+    gp = m.goodput()
+    trace_summary = None
+    if tracer is not None:
+        exported = tracer.export(trace_path)
+        valid = tracer.validate()
+        # the trace invariants must hold under the chaos, same bar as
+        # zero-lost: a malformed span is an engine bug, not viewer noise
+        assert not valid["anomalies"], f"trace anomalies: {valid['anomalies']}"
+        trace_summary = {"path": exported["path"],
+                         "events": exported["events"],
+                         "dropped": exported["dropped"],
+                         "malformed_spans": 0}
     return {
         "metric": "chaos_serve_lost_requests",
         "value": len(lost),
@@ -238,6 +272,11 @@ def run(
             "steps_poisoned": m.steps_poisoned.value,
             "requests_retried": m.requests_retried.value,
             "requests_expired": m.requests_expired.value,
+            "goodput_tokens_per_sec": round(gp["goodput_tokens_per_sec"], 2),
+            "slo_attainment": round(gp["slo_attainment"], 4),
+            "slo_classes": {name: round(c["attainment"], 4)
+                            for name, c in gp["classes"].items()},
+            "trace": trace_summary,
             "wall_s": round(time.perf_counter() - t0, 3),
         },
     }
@@ -305,6 +344,7 @@ def run_crash(
     timeout_s: float = 240.0,
     workdir: str | None = None,
     verify_parity: bool = True,
+    trace_path: str | None = None,
 ) -> dict:
     """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
     fresh engine from what survived on disk, and assert zero lost accepted
@@ -327,6 +367,7 @@ def run_crash(
         PrefixCacheConfig,
         RequestJournal,
         ServingEngine,
+        Tracer,
     )
     from accelerate_tpu.serving.journal import REC_FIRST_TOKEN
 
@@ -389,6 +430,7 @@ def run_crash(
     cfg = GPT2Config.tiny(dtype=jnp.float32)
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0))
+    tracer = Tracer() if trace_path else None
     engine = ServingEngine(
         module, params, max_concurrency=concurrency,
         prompt_buckets=BUCKETS, max_queue=n_requests + 1,
@@ -396,6 +438,7 @@ def run_crash(
         prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
                       if prefix_cache else False),
         journal=journal,
+        tracer=tracer,
     )
     report = engine.resume(source)
     # terminal outcome per accepted rid: child finishes from the journal,
@@ -440,6 +483,18 @@ def run_crash(
             f"token drift across {scenario} + resume: requests {drift}")
 
     m = engine.metrics
+    trace_summary = None
+    if tracer is not None:
+        exported = tracer.export(trace_path)
+        valid = tracer.validate()
+        # resume() replays every surviving request through the tracer
+        # (EV_SUBMIT recovered=True), so the invariants must hold across the
+        # crash boundary too
+        assert not valid["anomalies"], f"trace anomalies: {valid['anomalies']}"
+        trace_summary = {"path": exported["path"],
+                         "events": exported["events"],
+                         "dropped": exported["dropped"],
+                         "malformed_spans": 0}
     return {
         "metric": "chaos_serve_crash_lost_requests",
         "value": len(lost),
@@ -463,6 +518,7 @@ def run_crash(
             "downtime_s": round(report.downtime_s, 3),
             "parity_checked": checked,
             "parity_drift": len(drift),
+            "trace": trace_summary,
             "wall_s": round(time.perf_counter() - t0, 3),
         },
     }
@@ -483,6 +539,7 @@ def main() -> None:
             prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
             grace_s=float(os.environ.get("CHAOS_GRACE", 0.05)),
             verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+            trace_path=os.environ.get("CHAOS_TRACE") or None,
         )
         print(json.dumps(summary), flush=True)
         return
@@ -509,6 +566,7 @@ def main() -> None:
         prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
         verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
         mesh=mesh,
+        trace_path=os.environ.get("CHAOS_TRACE") or None,
     )
     print(json.dumps(summary), flush=True)
 
