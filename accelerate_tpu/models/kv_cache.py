@@ -171,6 +171,27 @@ def _is_index_leaf(path) -> bool:
     return getattr(path[-1], "key", None) == "cache_index"
 
 
+# --------------------------------------------------------- byte accounting
+def tree_nbytes(tree: Any) -> int:
+    """Total device bytes of every array leaf in a cache/pool pytree — the
+    exact allocation cost (`sum(leaf.nbytes)`), counting the int8 path's fp32
+    absmax scales and the cache_index cursors alongside the KV buffers. The
+    serving telemetry gauges (`serving/telemetry.py`) are contracted to match
+    this number exactly; tests/test_telemetry.py holds them to it."""
+    return sum(int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def tree_bytes_by_dtype(tree: Any) -> dict[str, int]:
+    """Per-dtype byte split of a cache/pool pytree (dtype name -> bytes,
+    sorted by name). Separates what int8 KV storage actually buys: the int8
+    buffers shrink, the fp32 scale planes ride along at full precision."""
+    out: dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        name = str(np.dtype(leaf.dtype))
+        out[name] = out.get(name, 0) + int(leaf.nbytes)
+    return dict(sorted(out.items()))
+
+
 def make_cache(module: Any, batch: int, shardings: Any = None) -> Any:
     """Allocate the zeroed ``[batch, n_positions, ...]`` per-slot decode cache
     pytree for ``module`` (the serving engine's slot pool) without running a

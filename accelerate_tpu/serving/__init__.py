@@ -26,6 +26,12 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .telemetry import (
+    NULL_TELEMETRY,
+    NullTelemetry,
+    TelemetryConfig,
+    TelemetryExporter,
+)
 from .trace import NULL_TRACER, NullTracer, TraceEvent, Tracer
 
 __all__ = [
@@ -49,6 +55,10 @@ __all__ = [
     "NullTracer",
     "NULL_TRACER",
     "TraceEvent",
+    "TelemetryExporter",
+    "TelemetryConfig",
+    "NullTelemetry",
+    "NULL_TELEMETRY",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_ABORTED",

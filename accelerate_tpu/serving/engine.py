@@ -65,7 +65,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..models.gpt2 import gpt2_sharding_rules
-from ..models.kv_cache import gather_block_rows, make_cache, scatter_cache_slots
+from ..models.kv_cache import (
+    gather_block_rows,
+    make_cache,
+    scatter_cache_slots,
+    tree_bytes_by_dtype,
+    tree_nbytes,
+)
 from ..parallel.mesh import ParallelismConfig, mesh_axis_size, serving_mesh
 from ..parallel.sharding import (
     infer_block_pool_shardings,
@@ -93,6 +99,7 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .telemetry import NULL_TELEMETRY
 from .trace import (
     EV_ADMIT,
     EV_DISPATCH,
@@ -259,6 +266,7 @@ class ServingEngine:
         collective_probe_every: int = 0,
         journal: Any = None,
         tracer: Any = None,
+        telemetry: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -376,6 +384,10 @@ class ServingEngine:
         # the queue actually changes.
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.scheduler.tracer = self.tracer
+        # continuous telemetry (serving/telemetry.py): ``telemetry=`` takes a
+        # `TelemetryExporter`; the default NULL_TELEMETRY keeps the one poll
+        # site in `step` a single attribute check — zero-overhead off.
+        self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
         # (key, compiled, wall_s) of the most recent jitted dispatch — the
         # compile-vs-replay flag EV_DISPATCH events carry
         self._last_dispatch: tuple[str, bool, float] = ("", False, 0.0)
@@ -826,6 +838,97 @@ class ServingEngine:
     def active_slots(self) -> int:
         return int(self._active.sum())
 
+    # --------------------------------------------------------------- telemetry
+    def memory_stats(self) -> dict[str, Any]:
+        """Live memory/occupancy gauges (`docs/observability.md` "Continuous
+        telemetry"). Host-side only: pool bytes are allocation-time constants
+        (`kv_cache.tree_nbytes` — exact `leaf.nbytes` sums), occupancy comes
+        from the host slot mirrors, and the per-device numbers use
+        `device.memory_stats()` when the backend provides it (TPU/GPU; a CPU
+        host simply omits them). Keys are unprefixed — the telemetry exporter
+        namespaces them under ``serving/mem/``."""
+        stats: dict[str, Any] = {
+            "slot_pool_bytes": tree_nbytes(self._cache),
+            "slots_total": self.max_concurrency,
+            "slots_active": self.active_slots,
+            "slots_free": len(self._free),
+            "queue_depth": self.scheduler.queue_depth,
+            "inflight_dispatches": len(self._inflight),
+        }
+        for dtype, n in tree_bytes_by_dtype(self._cache).items():
+            stats[f"slot_pool_bytes/{dtype}"] = n
+        if self.prefix_cache is not None:
+            for k, v in self.prefix_cache.memory_stats().items():
+                stats[f"block_pool/{k}"] = v
+        for i, dev in enumerate(jax.local_devices()):
+            try:
+                dm = dev.memory_stats()
+            except Exception:  # backend without stats support
+                continue
+            if not dm:  # CPU returns None / {}
+                continue
+            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+                if key in dm:
+                    stats[f"device{i}/{key}"] = int(dm[key])
+        return stats
+
+    def capacity_headroom(self) -> dict[str, Any]:
+        """Admission-capacity estimate — the predicted-TTFT admission input
+        (ROADMAP item 5). All host arithmetic over the slot mirrors:
+
+        - ``slots_free`` / ``queue_depth`` — raw occupancy;
+        - ``admissible_requests`` — requests admissible right now without
+          queuing behind existing work: free slots minus the queue already
+          waiting for them, floored at 0;
+        - ``decode_tokens_remaining`` — decode tokens still owed across
+          active slots at their current budgets;
+        - ``token_capacity_remaining`` — that plus ``max_len - 1`` per free
+          slot (the most any single admitted request can generate). Monotone
+          non-increasing as slots fill: admission converts a free slot's
+          ``max_len - 1`` into a budget that is never larger, and decode
+          only drains it;
+        - ``seconds_to_exhaustion`` — token capacity over the current decode
+          rate (`metrics.tokens_per_sec`): how long until every position is
+          consumed if nothing retires. None while the engine is idle (rate
+          0) — exporters serialize that as null, never inf;
+        - ``est_slot_free_s`` — predicted wait for the next free slot: 0
+          when one is free, else the smallest per-slot remaining budget over
+          the per-slot decode rate (aggregate rate / active slots). None
+          when no rate is observable yet.
+        """
+        free = len(self._free)
+        remaining: list[int] = []
+        for slot in range(self.max_concurrency):
+            if not self._active[slot]:
+                continue
+            request, out = self._slot_req[slot], self._slot_out[slot]
+            if request is None or out is None:
+                continue
+            plen = len(request.prompt)
+            budget = min(int(request.params.max_new_tokens),
+                         self.max_len - plen)
+            remaining.append(max(0, budget - len(out.tokens)))
+        decode_remaining = sum(remaining)
+        capacity = decode_remaining + free * (self.max_len - 1)
+        rate = self.metrics.tokens_per_sec()
+        exhaustion = capacity / rate if rate > 0 else None
+        if free > 0:
+            slot_free_s: float | None = 0.0
+        elif rate > 0 and remaining:
+            slot_free_s = min(remaining) * len(remaining) / rate
+        else:
+            slot_free_s = None
+        return {
+            "slots_free": free,
+            "queue_depth": self.scheduler.queue_depth,
+            "admissible_requests": max(0, free - self.scheduler.queue_depth),
+            "decode_tokens_remaining": decode_remaining,
+            "token_capacity_remaining": capacity,
+            "decode_tokens_per_sec": rate,
+            "seconds_to_exhaustion": exhaustion,
+            "est_slot_free_s": slot_free_s,
+        }
+
     # ------------------------------------------------------------ engine loop
     def step(self) -> list[RequestOutput]:
         """Admit into free slots, dispatch one decode step for every active
@@ -878,6 +981,8 @@ class ServingEngine:
         if (self.tracker is not None and self.metrics_log_every
                 and self._step_count % self.metrics_log_every == 0):
             self.metrics.log_to(self.tracker, step=self._step_count)
+        if self.telemetry.enabled:
+            self.telemetry.poll(self)
         return finished
 
     def run(self, requests: Iterable[Request], max_steps: int | None = None
@@ -939,7 +1044,8 @@ class ServingEngine:
             self._slo_never_served(queued)
             if self.tracer.enabled:
                 self.tracer.emit(EV_FINISH, request_id, reason=FINISH_ABORTED,
-                                 tokens=0, depth=len(self._inflight))
+                                 tokens=0, depth=len(self._inflight),
+                                 **self._slo_trace_attrs(queued.slo))
             if self.journal is not None:
                 self.journal.log_finish(request_id, FINISH_ABORTED, [])
             return RequestOutput(
@@ -1007,7 +1113,8 @@ class ServingEngine:
             if self.tracer.enabled:
                 self.tracer.emit(EV_FINISH, req.request_id,
                                  reason=FINISH_ABORTED,
-                                 tokens=len(req.resume_tokens), depth=0)
+                                 tokens=len(req.resume_tokens), depth=0,
+                                 **self._slo_trace_attrs(req.slo))
             if self.journal is not None:
                 self.journal.log_finish(req.request_id, FINISH_ABORTED,
                                         list(req.resume_tokens))
@@ -1447,7 +1554,8 @@ class ServingEngine:
             self._slo_never_served(request)
             if self.tracer.enabled:
                 self.tracer.emit(EV_REJECT, request.request_id,
-                                 reason=REJECT_DEADLINE, expired=True)
+                                 reason=REJECT_DEADLINE, expired=True,
+                                 **self._slo_trace_attrs(request.slo))
             if self.journal is not None:
                 self.journal.log_finish(
                     request.request_id, f"rejected:{REJECT_DEADLINE}", []
@@ -1671,6 +1779,16 @@ class ServingEngine:
                 ttft_ok=request.slo.ttft_s is None, itl_ok=True, tokens=0,
             )
 
+    @staticmethod
+    def _slo_trace_attrs(slo: Any, attained: bool = False) -> dict[str, Any]:
+        """SLO class + attainment verdict for a terminal trace event, so
+        `tools/trace_report.py --slo` re-tells `metrics.goodput()`'s story
+        from the trace alone. Empty for unclassed requests — their terminals
+        stay exactly the pre-SLO schema."""
+        if slo is None:
+            return {}
+        return {"slo": slo.name, "attained": bool(attained)}
+
     def _retire(self, slot: int, reason: str, now: float,
                 finished: list[RequestOutput]) -> None:
         out = self._slot_out[slot]
@@ -1696,7 +1814,7 @@ class ServingEngine:
             gaps = self._slot_itl[slot]
             if slo.itl_p99_s is not None and gaps:
                 itl_ok = nearest_rank(sorted(gaps), 0.99) <= slo.itl_p99_s
-        self.metrics.observe_slo(
+        attained = self.metrics.observe_slo(
             slo, clean=reason in (FINISH_EOS, FINISH_LENGTH),
             ttft_ok=ttft_ok, itl_ok=itl_ok,
             tokens=len(out.tokens) - len(request.resume_tokens),
@@ -1705,7 +1823,8 @@ class ServingEngine:
             self.tracer.emit(EV_FINISH, out.request_id, slot=slot,
                              gen=int(self._slot_gen[slot]), reason=reason,
                              tokens=len(out.tokens),
-                             depth=len(self._inflight))
+                             depth=len(self._inflight),
+                             **self._slo_trace_attrs(slo, attained))
         if self.journal is not None:
             # the terminal record carries the whole stream: completed work is
             # parity-checkable and dedupable from the journal alone

@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.kv_cache import make_block_pool, scatter_block_rows
+from ..models.kv_cache import make_block_pool, scatter_block_rows, tree_nbytes
 
 
 @dataclasses.dataclass(frozen=True)
@@ -284,6 +284,55 @@ class PrefixCache:
             count += 1
             stack.extend(node.children.values())
         return count
+
+    @property
+    def blocks_free(self) -> int:
+        """Pool blocks on the free list (never yet allocated, or returned by
+        an explicit clear — eviction recycles in place and bypasses it)."""
+        return len(self._free)
+
+    @property
+    def pool_nbytes(self) -> int:
+        """Exact device bytes of the block pool (constant after allocation —
+        the pool is never resized, only rewritten in place)."""
+        return tree_nbytes(self.pool)
+
+    def memory_stats(self) -> dict[str, int | float]:
+        """Host-side occupancy gauges for the telemetry exporter
+        (`serving/telemetry.py`, `docs/observability.md`). One trie walk, no
+        device work. Resident blocks split three ways:
+
+        - ``blocks_pinned`` — ref-counted by an in-flight request; eviction
+          may not touch them;
+        - ``blocks_evictable`` — unpinned leaves, exactly what `_evict_one`
+          can reclaim right now;
+        - ``blocks_stranded`` — unpinned *interior* nodes: resident but
+          unreclaimable until their whole subtree drains. ``fragmentation``
+          is stranded / resident (0.0 when the trie is empty) — the
+          ROADMAP's paged-KV argument wants this number measured, not
+          assumed.
+        """
+        pinned = evictable = resident = 0
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            resident += 1
+            if node.ref > 0:
+                pinned += 1
+            elif not node.children:
+                evictable += 1
+        stranded = resident - pinned - evictable
+        return {
+            "pool_bytes": self.pool_nbytes,
+            "blocks_total": self.num_blocks,
+            "blocks_free": len(self._free),
+            "blocks_resident": resident,
+            "blocks_pinned": pinned,
+            "blocks_evictable": evictable,
+            "blocks_stranded": stranded,
+            "fragmentation": stranded / resident if resident else 0.0,
+        }
 
 
 def cache_batch_size(cache: Any) -> int:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import functools
 import json
+import math
 import os
 import time
 from typing import Any, Callable
@@ -159,7 +160,15 @@ class JSONLTracker(GeneralTracker):
         entry = dict(listify(values))
         entry["_step"] = step
         entry["_ts"] = time.time()
-        self._fh.write(json.dumps(entry) + "\n")
+        # NaN/Inf serialize as null, never as the bare ``NaN`` literal
+        # json.dumps would otherwise emit (valid Python, invalid JSON — it
+        # breaks every strict reader downstream, serve_top included);
+        # allow_nan=False makes a missed case an error instead of bad output
+        entry = {
+            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+            for k, v in entry.items()
+        }
+        self._fh.write(json.dumps(entry, allow_nan=False) + "\n")
         self._fh.flush()
 
     @on_main_process

@@ -64,6 +64,11 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_PROBE_EVERY  mesh mode: collective-probe period in steps (1)
   BENCH_SERVE_TRACE        path: export the pipelined timed run's trace-event
                            JSON here (default: tracing off entirely)
+  BENCH_SERVE_TELEMETRY    path: attach a `serving.telemetry.TelemetryExporter`
+                           to the pipelined timed run — per-step JSONL
+                           time-series here, Prometheus text at path + ".prom"
+                           (view with `python tools/serve_top.py path`;
+                           default: telemetry off entirely)
 
 Run: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
 """
@@ -409,7 +414,7 @@ def main() -> None:
 
     from accelerate_tpu.serving import ServingMetrics
 
-    def timed_engine(pipeline_depth, tracer=None):
+    def timed_engine(pipeline_depth, tracer=None, telemetry=None):
         # warm pass on the SAME engine/jit caches: compile every (prompt,
         # batch) bucket and the decode step outside the timed region
         engine = ServingEngine(module, params, max_concurrency=concurrency,
@@ -420,11 +425,36 @@ def main() -> None:
         engine.metrics = ServingMetrics()  # drop the warm pass from the stats
         if tracer is not None:
             tracer.clear()  # the exported trace covers the timed window only
-        return _run_engine(engine, trace)
+        if telemetry is not None:
+            # attach AFTER the warm pass so the time-series covers only the
+            # timed window (same contract as the tracer's clear())
+            engine.telemetry = telemetry
+        result = _run_engine(engine, trace)
+        if telemetry is not None:
+            telemetry.sample(engine)  # final settled point after the drain
+        return result
 
     tracer = Tracer() if os.environ.get("BENCH_SERVE_TRACE") else None
+    telemetry = None
+    if os.environ.get("BENCH_SERVE_TELEMETRY"):
+        from accelerate_tpu.serving import TelemetryConfig, TelemetryExporter
+
+        telemetry = TelemetryExporter(TelemetryConfig(
+            interval_s=0.0,  # every step: bench runs are short, files small
+            jsonl_path=os.environ["BENCH_SERVE_TELEMETRY"],
+            prometheus_path=os.environ["BENCH_SERVE_TELEMETRY"] + ".prom",
+        ))
     sync_tps, sync_dt, sync_detail = timed_engine(1)
-    pipe_tps, pipe_dt, pipe_detail = timed_engine(depth, tracer)
+    pipe_tps, pipe_dt, pipe_detail = timed_engine(depth, tracer, telemetry)
+    telemetry_summary = None
+    if telemetry is not None:
+        telemetry_summary = {
+            "path": os.environ["BENCH_SERVE_TELEMETRY"],
+            "prometheus_path": os.environ["BENCH_SERVE_TELEMETRY"] + ".prom",
+            "points": len(telemetry.points()),
+            "dropped": telemetry.dropped,
+        }
+        telemetry.close()
     trace_summary = None
     if tracer is not None:
         exported = tracer.export(os.environ["BENCH_SERVE_TRACE"])
@@ -455,6 +485,7 @@ def main() -> None:
             "slo_attainment": pipe_detail["slo_attainment"],
             "slo_classes": pipe_detail["slo_classes"],
             "trace": trace_summary,
+            "telemetry": telemetry_summary,
             "vs_depth1": round(pipe_tps / sync_tps, 3),
             "host_blocked_ratio_d2_over_d1": round(
                 pipe_detail["host_blocked_per_step_s"]

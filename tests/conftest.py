@@ -63,6 +63,13 @@ def pytest_configure(config):
         "tests (serving/trace.py — docs/observability.md) — run standalone "
         "with `pytest -m trace`",
     )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: continuous telemetry / memory-capacity accounting tests "
+        "(serving/telemetry.py, engine memory_stats/capacity_headroom — "
+        "docs/observability.md \"Continuous telemetry\") — run standalone "
+        "with `pytest -m telemetry`",
+    )
 
 
 @pytest.fixture

@@ -1,7 +1,7 @@
 """End-to-end smoke tests for the repo's file-inspection CLIs —
-`tools/trace_report.py` and `tools/journal_fsck.py` — run as real
-subprocesses against generated fixtures, asserting the exit-code contract
-each tool documents:
+`tools/trace_report.py`, `tools/journal_fsck.py`, `tools/bench_gate.py`,
+and `tools/serve_top.py` — run as real subprocesses against generated
+fixtures, asserting the exit-code contract each tool documents:
 
     0  the file parsed and is clean
     1  the file parsed but carries anomalies (malformed spans / mid-file
@@ -40,6 +40,8 @@ from accelerate_tpu.serving.trace import (
 _REPO = Path(__file__).resolve().parent.parent
 _TRACE_REPORT = _REPO / "tools" / "trace_report.py"
 _JOURNAL_FSCK = _REPO / "tools" / "journal_fsck.py"
+_BENCH_GATE = _REPO / "tools" / "bench_gate.py"
+_SERVE_TOP = _REPO / "tools" / "serve_top.py"
 
 
 def _run(tool: Path, *args: str) -> subprocess.CompletedProcess:
@@ -150,3 +152,114 @@ def test_journal_fsck_exit_2_on_non_journal_file(tmp_path):
     proc = _run(_JOURNAL_FSCK, path)
     assert proc.returncode == 2
     assert json.loads(proc.stdout)["error"]
+
+
+# ------------------------------------------------------------ bench_gate
+def _bench_rows(path: Path, tps: float, ttft: float) -> None:
+    """Candidate in bench_serving's JSONL headline-row format."""
+    path.write_text("\n".join(json.dumps(r) for r in [
+        {"metric": "serving_tokens_per_sec", "value": tps, "detail": {}},
+        {"metric": "serving_ttft_p50_s", "value": ttft},
+    ]) + "\n")
+
+
+@pytest.mark.telemetry
+def test_bench_gate_exit_0_on_no_regression(tmp_path):
+    best = tmp_path / "best.json"
+    _bench_rows(best, tps=100.0, ttft=0.020)
+    cand = tmp_path / "cand.jsonl"
+    _bench_rows(cand, tps=101.0, ttft=0.019)  # faster on both axes
+    proc = _run(_BENCH_GATE, cand, "--best", best)
+    assert proc.returncode == 0, proc.stdout
+    report = json.loads(proc.stdout)
+    assert report["clean"] is True and report["regressions"] == []
+    assert {r["name"] for r in report["compared"]} == {
+        "serving_tokens_per_sec", "serving_ttft_p50_s"}
+
+
+@pytest.mark.telemetry
+def test_bench_gate_exit_1_on_regression_both_directions(tmp_path):
+    best = tmp_path / "best.json"
+    _bench_rows(best, tps=100.0, ttft=0.020)
+    # throughput (higher-better) collapsed
+    slow = tmp_path / "slow.jsonl"
+    _bench_rows(slow, tps=80.0, ttft=0.020)
+    proc = _run(_BENCH_GATE, slow, "--best", best)
+    assert proc.returncode == 1, proc.stdout
+    assert json.loads(proc.stdout)["regressions"] == ["serving_tokens_per_sec"]
+    # latency (lower-better by the _s suffix) blew up
+    laggy = tmp_path / "laggy.jsonl"
+    _bench_rows(laggy, tps=100.0, ttft=0.040)
+    proc = _run(_BENCH_GATE, laggy, "--best", best)
+    assert proc.returncode == 1, proc.stdout
+    assert json.loads(proc.stdout)["regressions"] == ["serving_ttft_p50_s"]
+    # within the widened per-metric threshold the same candidate passes
+    proc = _run(_BENCH_GATE, laggy, "--best", best,
+                "--metric-threshold", "serving_ttft_p50_s=2.0")
+    assert proc.returncode == 0, proc.stdout
+
+
+@pytest.mark.telemetry
+def test_bench_gate_exit_2_on_non_bench_file(tmp_path):
+    garbage = tmp_path / "garbage.bin"
+    garbage.write_bytes(b"\x00\x01 not a bench")
+    proc = _run(_BENCH_GATE, garbage)
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["error"]
+
+    # valid JSON, but nothing metric-shaped in it
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"notes": "hello"}))
+    assert _run(_BENCH_GATE, empty).returncode == 2
+
+
+@pytest.mark.telemetry
+def test_bench_gate_against_repo_best_record(tmp_path):
+    """The shipped BENCH_BEST.json (training shape: detail-only) gates a
+    matching candidate; a serving candidate has zero overlap with it, which
+    is clean by default and a failure only under --strict."""
+    cand = tmp_path / "train.json"
+    cand.write_text(json.dumps({"detail": {"mfu": 0.30, "loss": 6.0}}))
+    proc = _run(_BENCH_GATE, cand)  # default --best: repo BENCH_BEST.json
+    assert proc.returncode == 0, proc.stdout
+    report = json.loads(proc.stdout)
+    assert "mfu" in {r["name"] for r in report["compared"]}
+
+    serving = tmp_path / "serving.jsonl"
+    _bench_rows(serving, tps=100.0, ttft=0.020)
+    assert _run(_BENCH_GATE, serving).returncode == 0
+    assert _run(_BENCH_GATE, serving, "--strict").returncode == 1
+
+
+# ------------------------------------------------------------ serve_top
+@pytest.mark.telemetry
+def test_serve_top_exit_0_on_telemetry_jsonl(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    point = {
+        "_step": 12, "_ts": 1700000000.0,
+        "serving/tokens_per_sec": 123.4,
+        "serving/mem/slots_total": 4, "serving/mem/slots_active": 3,
+        "serving/mem/slots_free": 1, "serving/mem/queue_depth": 2,
+        "serving/mem/inflight_dispatches": 1,
+        "serving/mem/slot_pool_bytes": 262160,
+        "serving/headroom/admissible_requests": 0,
+        "serving/headroom/token_capacity_remaining": 381,
+        "serving/headroom/seconds_to_exhaustion": 3.1,
+    }
+    path.write_text(json.dumps(point) + "\n")
+    proc = _run(_SERVE_TOP, path)
+    assert proc.returncode == 0, proc.stderr
+    assert "serve_top — step 12" in proc.stdout
+    assert "3/4 active" in proc.stdout
+    assert "123.4 tok/s" in proc.stdout
+    assert "0 admissible" in proc.stdout
+
+
+@pytest.mark.telemetry
+def test_serve_top_exit_2_on_non_telemetry_file(tmp_path):
+    garbage = tmp_path / "garbage.jsonl"
+    garbage.write_text('{"loss": 1.0, "_step": 1}\n')  # jsonl, but no gauges
+    proc = _run(_SERVE_TOP, garbage)
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["error"]
+    assert _run(_SERVE_TOP, tmp_path / "missing.jsonl").returncode == 2

@@ -1,0 +1,196 @@
+"""Perf-regression gate: diff a fresh bench JSON against the repo's best
+record (`BENCH_BEST.json`) with per-metric thresholds, so the bench
+trajectory is enforceable instead of advisory (`docs/observability.md`
+"Continuous telemetry").
+
+Both sides accept either format the repo's benches produce: a whole-file
+JSON object (`BENCH_BEST.json`'s training record — its numeric ``detail``
+entries like ``mfu`` become metrics) or machine-readable JSON lines
+(`benchmarks/bench_serving.py`'s ``{"metric", "value", ...}`` rows). Only
+metrics present on BOTH sides are compared — the best-file legitimately
+accumulates records from different bench kinds, so a baseline-only metric is
+reported (``missing``) but fails the gate only under ``--strict``; a
+candidate-only metric is new and never fails.
+
+Direction is inferred from the name — ``*_s``/``*_ms`` suffixes and
+latency-ish names (ttft/itl/latency/blocked/wall/loss/compile) are
+lower-is-better, everything else higher-is-better — and overridable with
+``--lower-better NAME``. A metric regresses when it degrades by more than
+its threshold fraction (``--threshold`` default 0.05; per-metric overrides
+via ``--metric-threshold name=frac``).
+
+Prints ONE JSON report line. Exit status follows the `journal_fsck.py`
+convention: 0 = no regression, 1 = regression (or, with ``--strict``,
+missing/zero-overlap metrics), 2 = not a bench JSON at all (unreadable, or
+no metrics extractable from the candidate).
+
+Run:
+    python tools/bench_gate.py CANDIDATE.json [--best BENCH_BEST.json]
+        [--threshold 0.05] [--metric-threshold name=frac] [--detail]
+        [--strict] [--lower-better NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_DEFAULT_BEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_BEST.json",
+)
+
+_LOWER_BETTER_HINTS = ("ttft", "itl", "latency", "blocked", "wall", "loss",
+                       "compile")
+
+
+def lower_is_better(name: str, extra: tuple[str, ...] = ()) -> bool:
+    """Direction heuristic over the metric name (any path component)."""
+    if name in extra:
+        return True
+    last = name.rsplit("/", 1)[-1]
+    if last.endswith("_s") or last.endswith("_ms"):
+        return True
+    return any(h in name for h in _LOWER_BETTER_HINTS)
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _flatten(prefix: str, obj, out: dict[str, float]) -> None:
+    for k, v in obj.items():
+        name = f"{prefix}/{k}" if prefix else str(k)
+        if _numeric(v):
+            out[name] = float(v)
+        elif isinstance(v, dict):
+            _flatten(name, v, out)
+
+
+def load_metrics(path: str, *, detail: bool = False) -> dict[str, float]:
+    """Extract ``name -> value`` from a bench file. Headline rows
+    (``{"metric", "value"}``) always count; rows WITHOUT a ``metric`` key
+    (the BENCH_BEST training shape) contribute their numeric ``detail``
+    entries instead. ``detail=True`` additionally flattens every headline
+    row's ``detail`` tree under ``<metric>/<path>``. Raises ``ValueError``
+    when the file holds no JSON objects or no metrics at all."""
+    with open(path) as f:
+        text = f.read()
+    objs: list[dict] = []
+    try:
+        doc = json.loads(text)
+        objs = [o for o in (doc if isinstance(doc, list) else [doc])
+                if isinstance(o, dict)]
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict):
+                objs.append(doc)
+    if not objs:
+        raise ValueError(f"{path}: no JSON objects found")
+    metrics: dict[str, float] = {}
+    for obj in objs:
+        if "metric" in obj:
+            if _numeric(obj.get("value")):
+                metrics[str(obj["metric"])] = float(obj["value"])
+            if detail and isinstance(obj.get("detail"), dict):
+                _flatten(str(obj["metric"]), obj["detail"], metrics)
+        elif isinstance(obj.get("detail"), dict):
+            _flatten("", obj["detail"], metrics)
+    if not metrics:
+        raise ValueError(f"{path}: no metrics extractable (not a bench JSON)")
+    return metrics
+
+
+def gate(candidate_path: str, best_path: str = _DEFAULT_BEST, *,
+         threshold: float = 0.05,
+         metric_thresholds: dict[str, float] | None = None,
+         lower_better: tuple[str, ...] = (),
+         detail: bool = False, strict: bool = False) -> dict:
+    """Run the gate; return the report dict (importable —
+    tests/test_tools_cli.py runs it). Raises ``OSError``/``ValueError`` when
+    either side is not a readable bench JSON."""
+    cand = load_metrics(candidate_path, detail=detail)
+    best = load_metrics(best_path, detail=detail)
+    metric_thresholds = metric_thresholds or {}
+    compared: list[dict] = []
+    regressions: list[str] = []
+    for name in sorted(set(cand) & set(best)):
+        thr = metric_thresholds.get(name, threshold)
+        lower = lower_is_better(name, lower_better)
+        b, c = best[name], cand[name]
+        delta = (c - b) / max(abs(b), 1e-12)
+        regressed = (delta > thr) if lower else (delta < -thr)
+        compared.append({
+            "name": name, "best": b, "candidate": c,
+            "direction": "lower" if lower else "higher",
+            "threshold": thr, "delta_frac": round(delta, 6),
+            "regressed": regressed,
+        })
+        if regressed:
+            regressions.append(name)
+    missing = sorted(set(best) - set(cand))
+    new = sorted(set(cand) - set(best))
+    clean = not regressions
+    if strict and (missing or not compared):
+        clean = False
+    return {
+        "path": str(candidate_path),
+        "best": str(best_path),
+        "compared": compared,
+        "regressions": regressions,
+        "missing": missing,
+        "new": new,
+        "strict": strict,
+        "clean": clean,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("candidate", help="fresh bench JSON to judge")
+    parser.add_argument("--best", default=_DEFAULT_BEST,
+                        help="baseline record (default: repo BENCH_BEST.json)")
+    parser.add_argument("--threshold", type=float, default=0.05,
+                        help="allowed degradation fraction (default 0.05)")
+    parser.add_argument("--metric-threshold", action="append", default=[],
+                        metavar="NAME=FRAC",
+                        help="per-metric threshold override (repeatable)")
+    parser.add_argument("--lower-better", action="append", default=[],
+                        metavar="NAME",
+                        help="force NAME to lower-is-better (repeatable)")
+    parser.add_argument("--detail", action="store_true",
+                        help="also compare flattened detail sub-metrics")
+    parser.add_argument("--strict", action="store_true",
+                        help="missing or zero-overlap metrics fail the gate")
+    args = parser.parse_args(argv)
+    try:
+        overrides: dict[str, float] = {}
+        for spec in args.metric_threshold:
+            name, _, frac = spec.partition("=")
+            if not name or not frac:
+                raise ValueError(f"bad --metric-threshold {spec!r}, "
+                                 "expected NAME=FRAC")
+            overrides[name] = float(frac)
+        report = gate(args.candidate, args.best, threshold=args.threshold,
+                      metric_thresholds=overrides,
+                      lower_better=tuple(args.lower_better),
+                      detail=args.detail, strict=args.strict)
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"path": args.candidate, "error": str(exc)}),
+              flush=True)
+        return 2
+    print(json.dumps(report), flush=True)
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

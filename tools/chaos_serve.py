@@ -100,6 +100,40 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
+def _assert_steady_state(engine) -> dict:
+    """The telemetry gauges (`engine.memory_stats` / `capacity_headroom`,
+    serving/telemetry.py) must report a fully clean engine once the chaos
+    drains: no leaked slots or queued work, zero stuck block-pool pins,
+    block accounting consistent, and admission headroom restored to full
+    capacity. A leak surviving the drain is an engine bug the chaos
+    uncovered — same bar as zero-lost. Returns the gauges for the summary."""
+    mem = engine.memory_stats()
+    head = engine.capacity_headroom()
+    assert (mem["slots_active"] == 0
+            and mem["slots_free"] == engine.max_concurrency), \
+        f"leaked slots after drain: {mem}"
+    assert mem["queue_depth"] == 0 and mem["inflight_dispatches"] == 0, \
+        f"work left after drain: {mem}"
+    if engine.prefix_cache is not None:
+        assert mem["block_pool/blocks_pinned"] == 0, \
+            f"stuck block pins after drain: {mem}"
+        assert (mem["block_pool/blocks_free"]
+                + mem["block_pool/blocks_resident"]
+                == mem["block_pool/blocks_total"]), \
+            f"block accounting inconsistent after drain: {mem}"
+    assert head["slots_free"] == engine.max_concurrency, \
+        f"headroom not restored after drain: {head}"
+    assert head["admissible_requests"] == engine.max_concurrency, \
+        f"headroom not restored after drain: {head}"
+    return {
+        "slot_pool_bytes": mem["slot_pool_bytes"],
+        "blocks_pinned": mem.get("block_pool/blocks_pinned", 0),
+        "blocks_resident": mem.get("block_pool/blocks_resident", 0),
+        "fragmentation": mem.get("block_pool/fragmentation", 0.0),
+        "admissible_requests": head["admissible_requests"],
+    }
+
+
 def run(
     n_requests: int = 24,
     concurrency: int = 4,
@@ -207,6 +241,7 @@ def run(
 
     lost = sorted(set(submitted) - set(terminal))
     assert not lost, f"lost requests (accepted but no terminal output): {lost}"
+    steady = _assert_steady_state(engine)
 
     # parity drift: every cleanly finished request — whether its prefill came
     # cold, from cached blocks, after an eviction, or via a watchdog
@@ -276,6 +311,7 @@ def run(
             "slo_attainment": round(gp["slo_attainment"], 4),
             "slo_classes": {name: round(c["attainment"], 4)
                             for name, c in gp["classes"].items()},
+            "steady_state": steady,
             "trace": trace_summary,
             "wall_s": round(time.perf_counter() - t0, 3),
         },
@@ -457,6 +493,9 @@ def run_crash(
     assert not lost, (
         f"lost requests (journaled as accepted, no terminal outcome after "
         f"{scenario} + resume): {lost}")
+    # the RESUMED engine must also settle to clean gauges — a crash-recovery
+    # path that leaks a pin or a slot would surface here
+    steady = _assert_steady_state(engine)
 
     # cross-crash parity: every cleanly finished stream — finished by the
     # child, drained by its handler, or resumed mid-stream by the fresh
@@ -518,6 +557,7 @@ def run_crash(
             "downtime_s": round(report.downtime_s, 3),
             "parity_checked": checked,
             "parity_drift": len(drift),
+            "steady_state": steady,
             "trace": trace_summary,
             "wall_s": round(time.perf_counter() - t0, 3),
         },

@@ -1,0 +1,180 @@
+"""serve_top: live ASCII view of a serving telemetry time-series
+(`serving/telemetry.py`, `docs/observability.md` "reading serve_top").
+
+Reads the JSONL time-series a `TelemetryExporter` writes (``jsonl_path=``,
+or ``BENCH_SERVE_TELEMETRY=path`` on `benchmarks/bench_serving.py`) and
+renders the latest point as a top(1)-style screen: slot/queue occupancy
+bars, decode rate vs goodput, latency percentiles, KV slot-pool and prefix
+block-pool byte accounting, and the capacity headroom estimate — plus a
+sparkline of the decode rate over the trailing window.
+
+One-shot by default (render the latest point and exit); ``--watch N``
+re-reads the file every N seconds until interrupted, like ``top``. All
+analysis is host-side JSON arithmetic; nothing imports jax.
+
+Exit status: 0 = rendered, 2 = not a telemetry time-series (unreadable, or
+no points carrying ``serving/`` gauges).
+
+Run:
+    python tools/serve_top.py PATH [--watch SECONDS] [--width N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+_SPARK = " .:-=+*#%@"
+
+
+def load_points(path: str) -> list[dict]:
+    """Parse one telemetry JSONL file. Raises ``ValueError`` unless at least
+    one line is a JSON object carrying ``serving/`` gauges and a ``_ts``
+    stamp (the `TelemetryExporter` conventions)."""
+    points: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if (isinstance(doc, dict) and "_ts" in doc
+                    and any(k.startswith("serving/") for k in doc)):
+                points.append(doc)
+    if not points:
+        raise ValueError(f"{path} is not a telemetry time-series "
+                         "(no serving/ gauge points)")
+    return points
+
+
+def _bar(frac: float, width: int) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    fill = int(round(frac * width))
+    return "[" + "#" * fill + " " * (width - fill) + "]"
+
+
+def _sparkline(values: list[float], width: int) -> str:
+    if not values:
+        return ""
+    tail = values[-width:]
+    hi = max(tail)
+    if hi <= 0:
+        return " " * len(tail)
+    return "".join(
+        _SPARK[min(int(v / hi * (len(_SPARK) - 1)), len(_SPARK) - 1)]
+        for v in tail
+    )
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def render(point: dict, history: list[dict] | None = None,
+           width: int = 30) -> str:
+    """Render one time-series point (plus optional trailing history for the
+    rate sparkline) as the serve_top screen. Importable — the CLI tests and
+    doc examples call it directly."""
+    g = point.get  # gauges; missing ones render as absent lines
+    lines: list[str] = []
+    ts = point.get("_ts")
+    stamp = time.strftime("%H:%M:%S", time.localtime(ts)) if ts else "?"
+    lines.append(f"serve_top — step {point.get('_step', '?')} @ {stamp}")
+
+    total = g("serving/mem/slots_total")
+    active = g("serving/mem/slots_active")
+    if total:
+        lines.append(f"slots  {_bar(active / total, width)} "
+                     f"{active}/{total} active, "
+                     f"{g('serving/mem/slots_free')} free")
+    qd = g("serving/mem/queue_depth")
+    if qd is not None:
+        lines.append(f"queue  depth {qd}, inflight dispatches "
+                     f"{g('serving/mem/inflight_dispatches')}")
+
+    tps = g("serving/tokens_per_sec", g("serving/headroom/decode_tokens_per_sec"))
+    if tps is not None:
+        spark = ""
+        if history:
+            rates = [p.get("serving/headroom/decode_tokens_per_sec") or 0.0
+                     for p in history]
+            spark = f"  [{_sparkline(rates, width)}]"
+        lines.append(f"rate   {tps:.1f} tok/s{spark}")
+    gps = g("serving/goodput_tokens_per_sec")
+    if gps is not None:
+        lines.append(f"goodput {gps:.1f} tok/s, "
+                     f"attainment {g('serving/slo_attainment', 1.0):.2%}")
+    ttft_p50 = g("serving/ttft_s/p50")
+    if ttft_p50 is not None:
+        lines.append(f"ttft   p50 {1e3 * ttft_p50:.1f} ms, "
+                     f"p99 {1e3 * g('serving/ttft_s/p99', 0.0):.1f} ms")
+
+    pool = g("serving/mem/slot_pool_bytes")
+    if pool is not None:
+        by_dtype = ", ".join(
+            f"{k.rsplit('/', 1)[-1]} {_human_bytes(v)}"
+            for k, v in sorted(point.items())
+            if k.startswith("serving/mem/slot_pool_bytes/"))
+        lines.append(f"kv     slot pool {_human_bytes(pool)}"
+                     + (f" ({by_dtype})" if by_dtype else ""))
+    bt = g("serving/mem/block_pool/blocks_total")
+    if bt:
+        resident = g("serving/mem/block_pool/blocks_resident", 0)
+        lines.append(
+            f"blocks {_bar(resident / bt, width)} {resident}/{bt} resident "
+            f"({g('serving/mem/block_pool/blocks_pinned', 0)} pinned, "
+            f"{g('serving/mem/block_pool/blocks_evictable', 0)} evictable), "
+            f"frag {g('serving/mem/block_pool/fragmentation', 0.0):.2f}, "
+            f"pool {_human_bytes(g('serving/mem/block_pool/pool_bytes', 0))}")
+
+    adm = g("serving/headroom/admissible_requests")
+    if adm is not None:
+        exhaust = g("serving/headroom/seconds_to_exhaustion")
+        lines.append(
+            f"head   {adm} admissible, "
+            f"{g('serving/headroom/token_capacity_remaining')} tokens left, "
+            f"exhaustion "
+            + (f"{exhaust:.1f}s" if exhaust is not None else "idle"))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="telemetry JSONL written by "
+                                     "serving.telemetry.TelemetryExporter")
+    parser.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                        help="re-read and re-render every N seconds "
+                             "(default: render once and exit)")
+    parser.add_argument("--width", type=int, default=30,
+                        help="bar/sparkline width (default 30)")
+    args = parser.parse_args(argv)
+    while True:
+        try:
+            points = load_points(args.path)
+        except (OSError, ValueError) as exc:
+            print(json.dumps({"path": args.path, "error": str(exc)}),
+                  flush=True)
+            return 2
+        screen = render(points[-1], history=points, width=args.width)
+        if args.watch > 0:
+            print("\x1b[2J\x1b[H" + screen, flush=True)  # clear + home
+            try:
+                time.sleep(args.watch)
+            except KeyboardInterrupt:
+                return 0
+        else:
+            print(screen, flush=True)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,9 +12,13 @@ trace-stream invariant checks (`trace.validate`), and prints:
     vs replays, mean host-blocked fetch time);
   - a slot-occupancy timeline (busy fraction per slot plus an ASCII bar —
     the prefill-stalls-decode bubble is visible as synchronized gaps);
-  - the top-N slowest requests with their phase split.
+  - the top-N slowest requests with their phase split;
+  - with ``--slo``, per-class SLO attainment and goodput recomputed from
+    the embedded raw stream (the engine stamps class + attained on every
+    classed terminal), so trace files and metrics snapshots tell one story.
 
-``--json`` prints the full report as one JSON document instead of text.
+``--json`` prints the full report as one JSON document instead of text
+(the SLO section always rides in the JSON under ``slo``).
 
 Exit status: 0 = clean trace, 1 = malformed spans (invariant violations —
 an engine bug, not a viewer problem), 2 = not a trace file at all
@@ -170,6 +174,50 @@ def report(path: str, *, top: int = 5, slots: bool = True) -> dict:
         key=lambda r: -r["total_s"],
     )[: max(0, top)]
 
+    # --- SLO attainment / goodput from the raw stream ---------------------
+    # one story with metrics.goodput() (docs/observability.md): class from
+    # the submit edge (or the terminal's own stamp), attainment from the
+    # engine-stamped ``attained`` flag on the terminal; traces predating the
+    # flag fall back to clean-finish (reason eos/length, matching
+    # request.FINISH_EOS/FINISH_LENGTH)
+    slo_classes: dict[str, dict] = {}
+    for rid, stream in sorted(request_streams(events).items()):
+        cls = None
+        for ev in stream:
+            if ev.data.get("slo") is not None:
+                cls = str(ev.data["slo"])
+        if cls is None:
+            continue
+        terminal = stream[-1] if stream[-1].kind in TERMINAL_KINDS else None
+        if terminal is not None and "attained" in terminal.data:
+            attained = bool(terminal.data["attained"])
+        else:
+            attained = (terminal is not None
+                        and terminal.data.get("reason") in ("eos", "length"))
+        c = slo_classes.setdefault(
+            cls, {"requests": 0, "attained": 0, "goodput_tokens": 0})
+        c["requests"] += 1
+        c["attained"] += int(attained)
+        if attained and terminal is not None:
+            c["goodput_tokens"] += int(terminal.data.get("tokens", 0))
+    span = (max(ev.ts for ev in events) - min(ev.ts for ev in events)
+            if events else 0.0)
+    slo_requests = sum(c["requests"] for c in slo_classes.values())
+    slo_attained = sum(c["attained"] for c in slo_classes.values())
+    goodput_tokens = sum(c["goodput_tokens"] for c in slo_classes.values())
+    slo = {
+        "classes": {
+            name: {**c, "attainment": c["attained"] / c["requests"]}
+            for name, c in sorted(slo_classes.items())
+        },
+        "slo_requests": slo_requests,
+        "slo_attainment": (slo_attained / slo_requests
+                           if slo_requests else 1.0),
+        "goodput_tokens": goodput_tokens,
+        "goodput_tokens_per_sec": (goodput_tokens / span if span > 0
+                                   else 0.0),
+    }
+
     return {
         "path": str(path),
         "events": valid["events"],
@@ -183,7 +231,22 @@ def report(path: str, *, top: int = 5, slots: bool = True) -> dict:
         "dispatch": dict(sorted(dispatch.items())),
         "slots": occupancy,
         "slowest": slowest,
+        "slo": slo,
     }
+
+
+def _print_slo(rep: dict) -> None:
+    slo = rep["slo"]
+    print(f"\nSLO attainment ({slo['slo_requests']} classed requests, "
+          f"overall {slo['slo_attainment']:.1%}, goodput "
+          f"{slo['goodput_tokens']} tok @ "
+          f"{slo['goodput_tokens_per_sec']:.1f} tok/s):")
+    if not slo["classes"]:
+        print("  (no requests carried an SLO class)")
+    for name, c in slo["classes"].items():
+        print(f"  {name:<14}{c['requests']:>6} requests, "
+              f"{c['attained']} attained ({c['attainment']:.1%}), "
+              f"{c['goodput_tokens']} goodput tokens")
 
 
 def _print_text(rep: dict) -> None:
@@ -235,6 +298,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="how many slowest requests to list (default 5)")
     parser.add_argument("--no-slots", action="store_true",
                         help="skip the slot-occupancy timeline")
+    parser.add_argument("--slo", action="store_true",
+                        help="print per-class SLO attainment and goodput "
+                             "from the embedded raw stream")
     parser.add_argument("--json", action="store_true",
                         help="print the full report as JSON instead of text")
     args = parser.parse_args(argv)
@@ -247,6 +313,8 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(rep), flush=True)
     else:
         _print_text(rep)
+        if args.slo:
+            _print_slo(rep)
     return 0 if rep["clean"] else 1
 
 
