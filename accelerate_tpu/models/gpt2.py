@@ -57,6 +57,15 @@ class GPT2Config:
     # continuous-batching slot pool — serving/engine.py). position_offset may
     # then be a [b] vector too.
     kv_cache_per_slot: bool = False
+    # paged KV: decode KV lives in a shared [kv_num_blocks, kv_block_tokens,
+    # ...] block pool instead of per-slot rows, and each row attends through
+    # its block table (models/kv_cache.paged_decode_update — the serving
+    # engine's paged_kv mode, docs/serving.md "Paged KV"). Implies the
+    # per-slot write-cursor semantics; block_tables must be threaded into
+    # __call__ on every decode step.
+    kv_cache_paged: bool = False
+    kv_num_blocks: int = 0
+    kv_block_tokens: int = 16
     # mesh layout for the per-slot cache (a parallel.sharding.KVCacheSharding,
     # hashable so the frozen config stays hashable): heads sharded on the
     # serving mesh's model axis, slots optionally on data. None everywhere but
@@ -102,7 +111,8 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
-                 cache_write_mask: jax.Array | None = None) -> jax.Array:
+                 cache_write_mask: jax.Array | None = None,
+                 block_tables: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.n_head
@@ -111,7 +121,31 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
         v = v.reshape(b, s, cfg.n_head, head_dim)
-        if decode:
+        if decode and cfg.kv_cache_paged:
+            # paged KV: the cache collection holds a shared block pool, each
+            # row attends through its block table (models/kv_cache.py)
+            from .kv_cache import paged_decode_update
+
+            k_all, v_all, idx, is_init = paged_decode_update(
+                self, k, v, cfg.kv_num_blocks, cfg.kv_block_tokens,
+                block_tables, write_mask=cache_write_mask,
+                sharding=cfg.kv_cache_sharding,
+            )
+            if is_init:
+                # same frontier mask as the per-slot path: the gathered view
+                # lays position p at index p, and everything past a row's
+                # cursor — pad offsets in its frontier block, unallocated
+                # table entries — is masked out before softmax, so stale pool
+                # contents contribute exactly zero
+                span = block_tables.shape[1] * cfg.kv_block_tokens
+                q_pos = idx[:, None, None] + jnp.arange(s)[None, :, None]
+                kv_pos = jnp.arange(span)[None, None, :]
+                mask = (kv_pos <= q_pos)[:, None]  # [b, 1, s, span]
+                out = attention(q, k_all, v_all, causal=False, mask=mask,
+                                implementation="xla")
+            else:
+                out = attention(q, k_all, v_all, causal=True, implementation="xla")
+        elif decode:
             # autoregressive KV cache (flax decode idiom): fixed n_positions-long
             # buffers, new keys/values written at the running index; optional
             # int8 storage (models/kv_cache.py)
@@ -173,11 +207,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
-                 cache_write_mask: jax.Array | None = None) -> jax.Array:
+                 cache_write_mask: jax.Array | None = None,
+                 block_tables: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         # pre-norm transformer; LN statistics in fp32
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_1")(x)
-        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode, cache_write_mask)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode, cache_write_mask, block_tables)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype), deterministic)
         return x
@@ -197,6 +232,7 @@ class GPT2LMHead(nn.Module):
         position_offset: jax.Array | int = 0,
         return_hidden: bool = False,
         cache_write_mask: jax.Array | None = None,
+        block_tables: jax.Array | None = None,
     ) -> jax.Array:
         cfg = self.config
         b, s = input_ids.shape
@@ -224,7 +260,7 @@ class GPT2LMHead(nn.Module):
             block = remat_block(Block, cfg.remat_policy, static_argnums=(2, 3))
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic, decode, cache_write_mask), None),
+                lambda mdl, carry, _: (mdl(carry, deterministic, decode, cache_write_mask, block_tables), None),
                 # fp8_meta (per-layer delayed-scaling state) stacks on the same
                 # leading layer axis as the params
                 variable_axes={"params": 0, "fp8_meta": 0},
@@ -234,7 +270,7 @@ class GPT2LMHead(nn.Module):
             )(block(cfg, name="blocks"), x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"block_{i}")(x, deterministic, decode, cache_write_mask)
+                x = block(cfg, name=f"block_{i}")(x, deterministic, decode, cache_write_mask, block_tables)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
         if return_hidden:

@@ -13,6 +13,7 @@ integration quantizes weights only.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any
 
 import jax
@@ -167,8 +168,138 @@ def decode_cache_update(
     return k_all, v_all, idx, True
 
 
+def paged_decode_update(
+    mod: Any,  # the flax module (self) owning the "cache" collection
+    k: jax.Array,  # [b, 1, kv_heads, head_dim] new keys (one token per step)
+    v: jax.Array,
+    num_blocks: int,  # pool size; block id == num_blocks is the dropped write
+    block_tokens: int,
+    block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
+    write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
+    sharding: Any = None,  # KVCacheSharding with pool kv / index / gathered
+) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
+    """Paged variant of `decode_cache_update`: the cache collection holds ONE
+    shared ``[num_blocks, block_tokens, ...]`` block pool (per layer) plus the
+    per-slot ``[b]`` write cursor, and each row's KV lives wherever its block
+    table says. Returns ``(k_all, v_all, write_index, is_init)`` exactly like
+    the slot-pool path, with ``k_all``/``v_all`` the gathered
+    ``[b, blocks_per_slot * block_tokens, ...]`` attended view.
+
+    Append-at-frontier write: row ``i``'s new entry lands in pool block
+    ``block_tables[i, idx[i] // block_tokens]`` at offset
+    ``idx[i] % block_tokens``. Rows frozen by ``write_mask`` redirect their
+    write to block id ``num_blocks`` — out of range, dropped by the scatter —
+    and keep their cursor, so a finished slot never mutates pool state while
+    host retirement lags the device. Unallocated table entries (the engine
+    leaves them 0) are never written — the cursor cannot reach past the
+    blocks admission reserved for the row's prompt + budget — and reads of
+    them are masked out of attention at the frontier, so stale pool contents
+    cannot perturb a stream (the parity bar of `docs/serving.md`).
+
+    int8 KV storage is not supported paged (quantization scales would need
+    their own block planes); the serving engine rejects the combination at
+    construction.
+    """
+    b, s, kv_heads, head_dim = k.shape
+    is_init = mod.has_variable("cache", "cached_key")
+    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), k.dtype)
+    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), v.dtype)
+    cache_idx = mod.variable("cache", "cache_index",
+                             lambda: jnp.zeros((b,), jnp.int32))
+    if not is_init:
+        return k, v, cache_idx.value, False
+    if s != 1:
+        raise ValueError(
+            f"paged decode writes one token per step, got a length-{s} segment "
+            "(prefill runs through the contiguous admission cache, then "
+            "scatter_rows_to_blocks)"
+        )
+    if block_tables is None:
+        raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
+    idx = cache_idx.value  # [b]
+    mask = (jnp.ones((b,), bool) if write_mask is None
+            else write_mask.astype(bool))
+    bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
+    bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
+    offs = idx % block_tokens
+    new_k = cached_k.value.at[bids, offs].set(k[:, 0], mode="drop")
+    new_v = cached_v.value.at[bids, offs].set(v[:, 0], mode="drop")
+    next_idx = idx + mask.astype(idx.dtype)
+    if sharding is not None:
+        new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
+        new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
+        next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
+    cached_k.value, cached_v.value = new_k, new_v
+    cache_idx.value = next_idx
+    # the attended view: each row's table blocks concatenated in token order —
+    # position p of row i sits at gathered index p (block p // block_tokens,
+    # offset p % block_tokens), the same layout the slot-pool cache has, so
+    # the caller's frontier mask is identical in both modes
+    blocks_per_slot = block_tables.shape[1]
+    k_all = new_k[block_tables].reshape(b, blocks_per_slot * block_tokens,
+                                        kv_heads, head_dim)
+    v_all = new_v[block_tables].reshape(b, blocks_per_slot * block_tokens,
+                                        kv_heads, head_dim)
+    if sharding is not None and getattr(sharding, "gathered", None) is not None:
+        k_all = jax.lax.with_sharding_constraint(k_all, sharding.gathered)
+        v_all = jax.lax.with_sharding_constraint(v_all, sharding.gathered)
+    return k_all, v_all, idx, True
+
+
 def _is_index_leaf(path) -> bool:
     return getattr(path[-1], "key", None) == "cache_index"
+
+
+class BlockAllocator:
+    """Host-side free-list over a device block pool's ids (paged KV serving,
+    `docs/serving.md` "Paged KV").
+
+    The pool itself is device state (`make_block_pool` leaves); this tracks
+    which block ids are owned — by a slot's private frontier or by the prefix
+    trie — purely on the host, so admission never round-trips the device to
+    find space. Allocation is all-or-nothing: a request that cannot get every
+    block it needs gets none (backpressure, never a half-placed request), and
+    a double free fails loudly (an aliasing bug would otherwise corrupt two
+    requests' KV silently).
+    """
+
+    def __init__(self, num_blocks: int):
+        num_blocks = int(num_blocks)
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: deque[int] = deque(range(num_blocks))
+        self._owned: set[int] = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def owned_count(self) -> int:
+        return len(self._owned)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` distinct block ids, or None when fewer than ``n`` are free
+        (all-or-nothing — the caller evicts or backs off, never partial)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        ids = [self._free.popleft() for _ in range(n)]
+        self._owned.update(ids)
+        return ids
+
+    def free(self, ids) -> None:
+        """Return block ids to the free list (slot retirement / trie eviction)."""
+        for b in ids:
+            b = int(b)
+            if b not in self._owned:
+                raise ValueError(f"double free of block {b}")
+            self._owned.discard(b)
+            self._free.append(b)
 
 
 # --------------------------------------------------------- byte accounting
@@ -330,4 +461,47 @@ def scatter_cache_slots(
 
     return _constrain_tree(
         jax.tree_util.tree_map_with_path(insert, pool_cache, new_cache), shardings
+    )
+
+
+def scatter_rows_to_blocks(
+    paged_cache: Any,  # paged cache pytree: KV [num_blocks, block_tokens, ...], cache_index [B]
+    new_cache: Any,  # an [nb, bucket, ...] freshly prefilled cache pytree
+    slots: jax.Array,  # [nb] int32 slot rows whose write cursor to stamp
+    dest_blocks: jax.Array,  # [nb, ceil(bucket / block_tokens)] pool ids; >= num_blocks drops
+    cache_index: jax.Array,  # [nb] int32 per-row resume index (true prefill length)
+    block_tokens: int,
+    shardings: Any = None,  # congruent NamedShardings keeping the pool's layout
+) -> Any:
+    """Paged admission: carve each freshly prefilled contiguous row into
+    ``block_tokens``-sized pieces and scatter them into the row's allocated
+    pool blocks in ONE op per leaf (the paged counterpart of
+    `scatter_cache_slots`). ``dest_blocks[i, j]`` is where row ``i``'s
+    ``j``-th piece lands; entries pointing past the pool (``num_blocks``)
+    are dropped — that is how a cache hit's ALIASED prefix blocks (already
+    resident, trie-pinned, shared zero-copy through the block table) and the
+    pad region past a short bucket are skipped without a second compile.
+
+    The ``cache_index`` leaf rows ``slots`` are stamped with ``cache_index``
+    (the true prefill length — decode's append frontier), exactly like the
+    slot-pool admission scatter.
+    """
+
+    def scatter(path, pool_leaf, new_leaf):
+        if _is_index_leaf(path):
+            return pool_leaf.at[slots].set(cache_index.astype(pool_leaf.dtype))
+        nb, bucket = new_leaf.shape[:2]
+        n_blk = dest_blocks.shape[1]
+        pad = n_blk * block_tokens - bucket
+        if pad:
+            new_leaf = jnp.pad(
+                new_leaf, [(0, 0), (0, pad)] + [(0, 0)] * (new_leaf.ndim - 2)
+            )
+        pieces = new_leaf.reshape((nb * n_blk, block_tokens) + new_leaf.shape[2:])
+        return pool_leaf.at[dest_blocks.reshape(-1)].set(
+            pieces.astype(pool_leaf.dtype), mode="drop"
+        )
+
+    return _constrain_tree(
+        jax.tree_util.tree_map_with_path(scatter, paged_cache, new_cache), shardings
     )

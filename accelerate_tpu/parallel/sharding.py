@@ -210,13 +210,21 @@ def constrain(x: Any, mesh: Mesh, spec: PartitionSpec) -> Any:
 
 @dataclass(frozen=True)
 class KVCacheSharding:
-    """The three NamedShardings a per-slot decode cache needs (hashable, so it
+    """The NamedShardings a per-slot decode cache needs (hashable, so it
     can ride inside a frozen model config — `GPT2Config.kv_cache_sharding` —
-    down to `models/kv_cache.decode_cache_update`'s in-jit constraints)."""
+    down to `models/kv_cache.decode_cache_update`'s in-jit constraints).
 
-    kv: NamedSharding  # [slots, max_len, kv_heads, head_dim] buffers
+    In paged mode (`kv_cache_sharding(..., paged=True)`) ``kv`` describes the
+    shared ``[num_blocks, block_tokens, ...]`` block pool instead of slot
+    rows, and ``gathered`` carries the layout of the per-slot attended view
+    the paged update assembles (`models/kv_cache.paged_decode_update`) — the
+    slot-pool layout, so attention math shards identically in both modes.
+    """
+
+    kv: NamedSharding  # [slots, max_len, kv_heads, head_dim] buffers (or the block pool)
     scale: NamedSharding  # [slots, max_len, kv_heads] int8 absmax scales
     index: NamedSharding  # [slots] write cursor
+    gathered: NamedSharding | None = None  # paged: [slots, span, kv_heads, head_dim] view
 
 
 def _is_cache_index(path) -> bool:
@@ -229,22 +237,52 @@ def kv_cache_sharding(
     slots: int | None = None,
     batch_axes: tuple[str, ...] = ("data",),
     head_axis: str = "tensor",
+    paged: bool = False,
 ) -> KVCacheSharding:
     """Build the `KVCacheSharding` for a slot-pool cache on ``mesh``.
 
     The slot dim is sharded over ``batch_axes`` only when ``slots`` divides
     their total degree (pass ``slots=None`` to force replication of the slot
     dim — the admission prefill's fresh rows use the head sharding alone).
+
+    ``paged=True`` describes the paged-KV layout instead: the block pool
+    replicates blocks across the data axis (any replica's slot may own or
+    alias any block — block ids ride as data, the table gather must be able
+    to reach the whole pool) and shards heads on the model axis; the per-slot
+    write cursor and the gathered attended view keep the slot-dim rules.
     """
     batch_axes = tuple(n for n in batch_axes if mesh.shape.get(n, 1) > 1)
     dsize = math.prod(mesh.shape[n] for n in batch_axes) if batch_axes else 1
     row = batch_axes if (slots is not None and dsize > 1 and slots % dsize == 0) else None
     head = head_axis if mesh.shape.get(head_axis, 1) > 1 else None
+    if paged:
+        return KVCacheSharding(
+            kv=NamedSharding(mesh, P(None, None, head, None)),
+            scale=NamedSharding(mesh, P(None, None, head)),
+            index=NamedSharding(mesh, P(row)),
+            gathered=NamedSharding(mesh, P(row, None, head, None)),
+        )
     return KVCacheSharding(
         kv=NamedSharding(mesh, P(row, None, head, None)),
         scale=NamedSharding(mesh, P(row, None, head)),
         index=NamedSharding(mesh, P(row)),
     )
+
+
+def block_table_sharding(
+    mesh: Mesh,
+    *,
+    slots: int | None = None,
+    batch_axes: tuple[str, ...] = ("data",),
+) -> NamedSharding:
+    """Sharding for the paged engine's ``[slots, blocks_per_slot]`` block
+    tables: the slot dim follows the cache's slot rule (sharded on the data
+    axes only when divisible), the table entries themselves replicate —
+    they are pool block IDS, data consumed by every tensor shard's gather."""
+    batch_axes = tuple(n for n in batch_axes if mesh.shape.get(n, 1) > 1)
+    dsize = math.prod(mesh.shape[n] for n in batch_axes) if batch_axes else 1
+    row = batch_axes if (slots is not None and dsize > 1 and slots % dsize == 0) else None
+    return NamedSharding(mesh, P(row, None))
 
 
 def infer_cache_shardings(cache: Any, sharding: KVCacheSharding) -> Any:

@@ -6,7 +6,7 @@ admission, per-request sampling params, FIFO queue with backpressure, and
 counters/histograms exported through the `tracking.py` tracker interface.
 """
 
-from .engine import RecoveryReport, ServingEngine
+from .engine import PagedKVConfig, RecoveryReport, ServingEngine
 from .journal import JournalError, JournalScan, RequestJournal
 from .metrics import Counter, Histogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixCacheConfig
@@ -36,6 +36,7 @@ from .trace import NULL_TRACER, NullTracer, TraceEvent, Tracer
 
 __all__ = [
     "ServingEngine",
+    "PagedKVConfig",
     "RecoveryReport",
     "RequestJournal",
     "JournalScan",

@@ -66,14 +66,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..models.gpt2 import gpt2_sharding_rules
 from ..models.kv_cache import (
+    BlockAllocator,
     gather_block_rows,
     make_cache,
     scatter_cache_slots,
+    scatter_rows_to_blocks,
     tree_bytes_by_dtype,
     tree_nbytes,
 )
 from ..parallel.mesh import ParallelismConfig, mesh_axis_size, serving_mesh
 from ..parallel.sharding import (
+    block_table_sharding,
     infer_block_pool_shardings,
     infer_cache_shardings,
     infer_param_shardings,
@@ -157,6 +160,26 @@ class _Inflight:
 # a JSON document written atomically (tmp + fsync + rename) by
 # `ServingEngine.snapshot`, restorable by `ServingEngine.resume`
 SNAPSHOT_FORMAT = "accelerate_tpu/serving-snapshot-v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVConfig:
+    """Knobs for the engine's ``paged_kv=`` argument (`docs/serving.md`
+    "Paged KV").
+
+    ``block_tokens`` is the allocation granularity: smaller blocks waste less
+    of the last partially-filled block per request (internal fragmentation
+    bounded by ``block_tokens - 1`` tokens) but mean a bigger table and more
+    allocator work per admission. Must be a power of two dividing
+    ``n_positions``, and must MATCH the prefix cache's ``block_tokens`` when
+    both are configured (the trie aliases pool blocks directly). ``num_blocks``
+    sizes the shared pool; None derives ``max_concurrency * (n_positions /
+    block_tokens)`` — byte-for-byte the slot pool's KV footprint, so any
+    concurrency gain is pure ragged-occupancy win, measured not assumed
+    (`benchmarks/bench_serving.py`'s ragged workload)."""
+
+    block_tokens: int = 16
+    num_blocks: int | None = None
 
 
 # Process-level cache of the unsharded engines' jitted programs. An unsharded
@@ -258,6 +281,7 @@ class ServingEngine:
         pipeline_depth: int = 2,
         admit_batch: int = 4,
         prefix_cache: PrefixCacheConfig | bool = False,
+        paged_kv: PagedKVConfig | bool = False,
         tracker: Any = None,
         metrics_log_every: int = 0,
         metrics: ServingMetrics | None = None,
@@ -278,6 +302,43 @@ class ServingEngine:
         self.max_concurrency = int(max_concurrency)
         if self.max_concurrency < 1:
             raise ValueError(f"max_concurrency must be >= 1, got {max_concurrency}")
+        # paged KV (docs/serving.md "Paged KV"): KV lives ONLY in a shared
+        # device-resident block pool — per-slot block tables replace the
+        # contiguous [b, n_positions] slot rows, admission reserves blocks on
+        # demand, and prefix-cache hits become zero-copy table aliasing. Off
+        # by default: the slot-pool path stays bit-for-bit what it was.
+        self.paged = bool(paged_kv)
+        self._allocator: BlockAllocator | None = None
+        self._block_tokens = 0
+        self._blocks_per_slot = 0
+        if self.paged:
+            pk = (paged_kv if isinstance(paged_kv, PagedKVConfig)
+                  else PagedKVConfig())
+            bt = int(pk.block_tokens)
+            n_pos = int(cfg.n_positions)
+            if bt < 1 or (bt & (bt - 1)) or n_pos % bt:
+                raise ValueError(
+                    f"paged_kv block_tokens must be a power of two dividing "
+                    f"n_positions={n_pos}, got {bt}"
+                )
+            if getattr(cfg, "kv_cache_dtype", None) is not None:
+                raise ValueError(
+                    "paged_kv does not support quantized (kv_cache_dtype) KV "
+                    "storage yet — the block pool stores the model dtype"
+                )
+            self._block_tokens = bt
+            self._blocks_per_slot = n_pos // bt
+            # default pool: byte-for-byte the slot pool's KV footprint, so a
+            # paged-vs-slot comparison at equal bytes needs no sizing math
+            n_blocks = (int(pk.num_blocks) if pk.num_blocks is not None
+                        else self.max_concurrency * self._blocks_per_slot)
+            if n_blocks < self._blocks_per_slot:
+                raise ValueError(
+                    f"num_blocks={n_blocks} cannot seat even one full-context "
+                    f"request ({self._blocks_per_slot} blocks of "
+                    f"{bt} tokens) — admission would backpressure forever"
+                )
+            self._allocator = BlockAllocator(n_blocks)
         # mesh-sharded serving (docs/serving.md "Sharded serving"): ``mesh`` is
         # a Mesh, a ParallelismConfig, or a (data, model) tuple. The model axis
         # is the standard ``tensor`` axis — params shard by the training-path
@@ -296,6 +357,7 @@ class ServingEngine:
         self._param_shardings = None
         self._row_sharding = None     # [max_concurrency] per-slot state vectors
         self._rep_sharding = None     # replicated scalars / [nb] admission inputs
+        self._table_sharding = None   # [max_concurrency, blocks_per_slot] tables
         if self.mesh is not None:
             extra = {n: s for n, s in self.mesh.shape.items()
                      if n not in ("data", "tensor") and s > 1}
@@ -310,11 +372,17 @@ class ServingEngine:
                     f"n_head={cfg.n_head} (attention is sharded over heads)"
                 )
             self._slot_sharding = kv_cache_sharding(
-                self.mesh, slots=self.max_concurrency
+                self.mesh, slots=self.max_concurrency, paged=self.paged
             )
             self._fresh_sharding = kv_cache_sharding(self.mesh, slots=None)
             self._row_sharding = self._slot_sharding.index
             self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
+            if self.paged:
+                # block tables follow the slot dim's layout (each replica
+                # indexes the replicated pool through its own slots' rows)
+                self._table_sharding = block_table_sharding(
+                    self.mesh, slots=self.max_concurrency
+                )
         # contiguous slot ranges per data replica (the slot dim shards like any
         # leading batch dim: replica i owns rows [i*b/d, (i+1)*b/d)) — 1 when
         # the slot dim is replicated (b % data != 0, or no mesh)
@@ -326,6 +394,13 @@ class ServingEngine:
         updates: dict[str, Any] = {}
         if not cfg.kv_cache_per_slot:
             updates["kv_cache_per_slot"] = True
+        if self.paged:
+            # the DECODE module owns the block pool; its cache collection is
+            # the [num_blocks, block_tokens, ...] pool plus the per-slot
+            # cursor, and every decode step attends through the block table
+            updates["kv_cache_paged"] = True
+            updates["kv_num_blocks"] = self._allocator.num_blocks
+            updates["kv_block_tokens"] = self._block_tokens
         if self.mesh is not None and hasattr(cfg, "kv_cache_sharding"):
             updates["kv_cache_sharding"] = self._slot_sharding
         if updates:
@@ -334,11 +409,20 @@ class ServingEngine:
         # admission prefills a FRESH nb-row cache (nb = batch bucket, not b):
         # its in-jit cache constraints must be the head-only layout — slot-dim
         # specs applied to nb rows would be a different (often indivisible)
-        # partitioning, so admission traces a config carrying ``_fresh_sharding``
-        self._admit_module = module
+        # partitioning, so admission traces a config carrying ``_fresh_sharding``.
+        # Paged admission ALSO prefills contiguous rows (numerics identical to
+        # slot-mode admission, the parity anchor) — only the post-prefill
+        # scatter targets the block pool — so the admit module always carries
+        # the contiguous per-slot cache layout.
+        admit_updates: dict[str, Any] = {}
+        if self.paged:
+            admit_updates["kv_cache_paged"] = False
         if self.mesh is not None and hasattr(cfg, "kv_cache_sharding"):
+            admit_updates["kv_cache_sharding"] = self._fresh_sharding
+        self._admit_module = module
+        if admit_updates:
             self._admit_module = type(module)(dataclasses.replace(
-                module.config, kv_cache_sharding=self._fresh_sharding
+                module.config, **admit_updates
             ))
         self.params = params
         if self.mesh is not None:
@@ -410,7 +494,12 @@ class ServingEngine:
             self._cache_shardings = infer_cache_shardings(
                 cache_shapes, self._slot_sharding
             )
-            self._pool_shardings = infer_block_pool_shardings(cache_shapes, self.mesh)
+            # the prefix cache's standalone pool exists only in slot mode —
+            # paged mode's trie aliases the engine's own pool blocks
+            self._pool_shardings = (
+                None if self.paged
+                else infer_block_pool_shardings(cache_shapes, self.mesh)
+            )
         self._cache = make_cache(self.module, b, shardings=self._cache_shardings)
         kd = jax.random.key_data(jax.random.key(0))
         self._rng_data = jnp.zeros((b,) + kd.shape, kd.dtype)
@@ -433,8 +522,24 @@ class ServingEngine:
                  self._no_poison)
             )
             self._d_eos = jax.device_put(self._d_eos, self._rep_sharding)
+        # paged: per-slot block tables, the ONLY indirection decode follows.
+        # A free slot's row points at num_blocks (out of range): a lagged
+        # step's write for a cancelled tenant DROPS instead of landing in a
+        # freed — possibly re-allocated — block (see _release_slot)
+        self._d_tables = None
+        if self.paged:
+            self._d_tables = jnp.full(
+                (b, self._blocks_per_slot), self._allocator.num_blocks,
+                jnp.int32,
+            )
+            if self.mesh is not None:
+                self._d_tables = jax.device_put(
+                    self._d_tables, self._table_sharding)
+        # fresh-row shapes come from the ADMIT module: in paged mode the
+        # decode module's cache is the pool, not the contiguous per-row
+        # layout admission prefills into
         self._fresh_shapes = jax.eval_shape(
-            lambda: self.module.init(
+            lambda: self._admit_module.init(
                 jax.random.key(0), jnp.zeros((1, 1), jnp.int32), decode=True
             )["cache"]
         )
@@ -481,18 +586,48 @@ class ServingEngine:
         self.prefix_cache: PrefixCache | None = None
         self._slot_match: list[PrefixMatch | None] = [None] * b
         self._slot_hit = np.zeros(b, bool)
+        # paged per-slot bookkeeping: the host copy of the slot's block table
+        # (what _retire donates from), the slot's PRIVATE block ids (freed at
+        # release — aliased prefix blocks belong to the trie, pinned via
+        # _slot_match), and how many leading table entries are aliased
+        self._slot_priv: list[list[int]] = [[] for _ in range(b)]
+        self._slot_table_host: list[np.ndarray | None] = [None] * b
+        self._slot_aliased = np.zeros(b, np.int32)
         if prefix_cache:
             pc_cfg = (prefix_cache if isinstance(prefix_cache, PrefixCacheConfig)
                       else PrefixCacheConfig())
-            self.prefix_cache = PrefixCache(
-                self._cache, max_len=self.max_len,
-                block_tokens=pc_cfg.block_tokens, num_blocks=pc_cfg.num_blocks,
-                metrics=self.metrics, shardings=self._pool_shardings,
-            )
+            if self.paged:
+                if int(pc_cfg.block_tokens) != self._block_tokens:
+                    raise ValueError(
+                        f"prefix_cache block_tokens={pc_cfg.block_tokens} must "
+                        f"equal paged_kv block_tokens={self._block_tokens}: "
+                        f"the trie aliases the engine's pool blocks directly"
+                    )
+                # paged trie: no standalone pool — entries pin blocks of the
+                # engine's own block pool (zero-copy hits, adopt-not-copy
+                # donation); num_blocks/shardings are the engine's
+                self.prefix_cache = PrefixCache(
+                    None, max_len=self.max_len,
+                    block_tokens=self._block_tokens,
+                    metrics=self.metrics, allocator=self._allocator,
+                )
+            else:
+                self.prefix_cache = PrefixCache(
+                    self._cache, max_len=self.max_len,
+                    block_tokens=pc_cfg.block_tokens, num_blocks=pc_cfg.num_blocks,
+                    metrics=self.metrics, shardings=self._pool_shardings,
+                )
             self.scheduler.prefill_len_fn = self._prefill_len
-            self._cached_admit_fn = self._build_cached_admit_fn()
+            self._cached_admit_fn = (self._build_paged_cached_admit_fn()
+                                     if self.paged
+                                     else self._build_cached_admit_fn())
+        if self.paged:
+            # admission is gated on BLOCKS, not just free slots: the scheduler
+            # shrinks each front run to what the pool can actually seat
+            self.scheduler.capacity_fn = self._paged_capacity
         self._step_fn = self._build_step_fn()
-        self._admit_fn = self._build_admit_fn()
+        self._admit_fn = (self._build_paged_admit_fn() if self.paged
+                          else self._build_admit_fn())
         # compile telemetry: every jitted serving program's first dispatch is
         # timed (the python call blocks through trace+compile; execution stays
         # async, so the first-call wall time is compile-dominated) under a
@@ -593,6 +728,8 @@ class ServingEngine:
 
     # ------------------------------------------------------------- jitted fns
     def _build_step_fn(self):
+        if self.paged:
+            return self._build_paged_step_fn()
         module = self.module
 
         def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data,
@@ -779,6 +916,188 @@ class ServingEngine:
                            row, row, row, row, row, row, row),
         )
 
+    def _build_paged_step_fn(self):
+        """Decode through the block table: identical sampling tail to the
+        slot-pool step (the parity anchor), but the cache rides as the shared
+        block pool and each row attends the gathered view its table describes
+        (`kv_cache.paged_decode_update` — same token layout, same frontier
+        mask, so logits match the slot path bit-for-bit)."""
+        module = self.module
+
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data,
+                    finished, remaining, poison, eos_id, tables):
+            live = ~finished
+            # finished slots freeze exactly as in slot mode; paged adds one
+            # more drop layer — a released slot's table row points at
+            # num_blocks, so even a stale dispatch's write cannot land
+            logits, mutated = module.apply(
+                {"params": params, "cache": cache}, tokens[:, None], decode=True,
+                position_offset=pos, mutable=["cache"], cache_write_mask=live,
+                block_tables=tables,
+            )
+            last = logits[:, -1]
+            last = jnp.where(poison[:, None], jnp.asarray(jnp.nan, last.dtype), last)
+            ok = jnp.all(jnp.isfinite(last), axis=-1)
+            rngs = jax.random.wrap_key_data(rng_data)
+            split = jax.vmap(jax.random.split)(rngs)  # [b, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            sampled = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            healthy = live & ok
+            nxt = jnp.where(healthy, sampled, tokens)
+            new_pos = jnp.where(healthy, pos + 1, pos)
+            new_remaining = jnp.where(healthy, remaining - 1, remaining)
+            hit_eos = (eos_id >= 0) & (nxt == eos_id)
+            new_finished = finished | (live & (~ok | hit_eos | (new_remaining <= 0)))
+            return (mutated["cache"], nxt, new_pos, new_remaining, new_finished,
+                    jax.random.key_data(new_rngs), ok | finished)
+
+        if self.mesh is None:
+            return _shared_jit(module, "step",
+                               lambda: jax.jit(step_fn, donate_argnums=(0,)))
+        row, rep = self._row_sharding, self._rep_sharding
+        return jax.jit(
+            step_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          row, row, row, row, row, row, row, row, rep,
+                          self._table_sharding),
+            out_shardings=(self._cache_shardings, row, row, row, row, row, row),
+        )
+
+    def _build_paged_admit_fn(self):
+        """Plain admission, paged pool: prefill the group into a FRESH
+        contiguous nb-row cache — byte-identical numerics to slot-mode
+        admission — then one scatter moves each row's newly written blocks
+        into the pool at the slot's reserved block ids and stamps the device
+        block tables. ``dest_blocks`` entries of ``num_blocks`` (aliased
+        prefix blocks on the cached path, reserved-but-unwritten decode
+        blocks) drop their write."""
+        module, fresh_shapes = self._admit_module, self._fresh_shapes
+        cache_shardings = self._cache_shardings
+        bt = self._block_tokens
+
+        def admit_fn(pool_cache, params, prompt_rows, slots, prompt_lens,
+                     temps, top_ks, rng_batch, budgets, dest_blocks,
+                     group_tables, d_tables, d_tokens, d_pos, d_temps,
+                     d_topks, d_finished, d_remaining, rng_data, eos_id):
+            nb = prompt_rows.shape[0]
+            fresh = jax.tree.map(
+                lambda s: jnp.zeros((nb,) + s.shape[1:], s.dtype), fresh_shapes
+            )
+            logits, mutated = module.apply(
+                {"params": params, "cache": fresh}, prompt_rows, decode=True,
+                position_offset=0, mutable=["cache"],
+            )
+            last = jax.vmap(
+                lambda row, n: jax.lax.dynamic_slice(
+                    row, (n - 1, 0), (1, row.shape[-1])
+                )[0]
+            )(logits, prompt_lens)
+            rngs = jax.random.wrap_key_data(rng_batch)
+            split = jax.vmap(jax.random.split)(rngs)  # [nb, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            first = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            new_pool = scatter_rows_to_blocks(
+                pool_cache, mutated["cache"], slots, dest_blocks, prompt_lens,
+                bt, shardings=cache_shardings,
+            )
+            d_tables = d_tables.at[slots].set(group_tables)
+            rem0 = budgets - 1
+            fin0 = (rem0 <= 0) | ((eos_id >= 0) & (first == eos_id))
+            d_tokens = d_tokens.at[slots].set(first)
+            d_pos = d_pos.at[slots].set(prompt_lens)
+            d_temps = d_temps.at[slots].set(temps)
+            d_topks = d_topks.at[slots].set(top_ks)
+            d_finished = d_finished.at[slots].set(fin0)
+            d_remaining = d_remaining.at[slots].set(rem0)
+            rng_data = rng_data.at[slots].set(jax.random.key_data(new_rngs))
+            return (new_pool, first, fin0, d_tables, d_tokens, d_pos, d_temps,
+                    d_topks, d_finished, d_remaining, rng_data)
+
+        if self.mesh is None:
+            return _shared_jit(module, "paged_admit",
+                               lambda: jax.jit(admit_fn, donate_argnums=(0,)))
+        row, rep = self._row_sharding, self._rep_sharding
+        tab = self._table_sharding
+        return jax.jit(
+            admit_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          rep, rep, rep, rep, rep, rep, rep, rep, rep,
+                          tab, row, row, row, row, row, row, row, rep),
+            out_shardings=(self._cache_shardings, rep, rep, tab,
+                           row, row, row, row, row, row, row),
+        )
+
+    def _build_paged_cached_admit_fn(self):
+        """Cached admission, paged pool: the matched prefix is ALIASED, never
+        copied — `gather_block_rows` assembles contiguous per-row views
+        straight out of the engine's own pool as a compute transient, the
+        uncached suffix prefills on top exactly like the slot path, and the
+        scatter writes ONLY the suffix's blocks back (aliased entries carry
+        dest id ``num_blocks`` — dropped). The slot's table then points at
+        the trie's pinned blocks for the prefix and its own fresh blocks for
+        the rest: the zero-copy sharing the slot path's `gather` +
+        `scatter_cache_slots` round trip paid a pool-to-slot copy for."""
+        module = self._admit_module
+        cache_shardings = self._cache_shardings
+        fresh_shardings = self._fresh_shardings
+        bt = self._block_tokens
+
+        def admit_fn(pool_cache, params, gather_tables, cached_lens,
+                     suffix_rows, suffix_lens, slots, temps, top_ks,
+                     rng_batch, budgets, dest_blocks, group_tables, d_tables,
+                     d_tokens, d_pos, d_temps, d_topks, d_finished,
+                     d_remaining, rng_data, eos_id):
+            # table entries past a row's real prefix (fresh private blocks,
+            # or the num_blocks sentinel clamped by the gather) read garbage
+            # the suffix write overwrites or the causal mask never admits
+            fresh = gather_block_rows(pool_cache, gather_tables, cached_lens,
+                                      shardings=fresh_shardings)
+            logits, mutated = module.apply(
+                {"params": params, "cache": fresh}, suffix_rows, decode=True,
+                position_offset=cached_lens, mutable=["cache"],
+            )
+            last = jax.vmap(
+                lambda row, n: jax.lax.dynamic_slice(
+                    row, (n - 1, 0), (1, row.shape[-1])
+                )[0]
+            )(logits, suffix_lens)
+            rngs = jax.random.wrap_key_data(rng_batch)
+            split = jax.vmap(jax.random.split)(rngs)  # [nb, 2] keys
+            new_rngs, keys = split[:, 0], split[:, 1]
+            first = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+            # decode resumes from the FULL prompt end: cached prefix + suffix
+            prompt_lens = cached_lens + suffix_lens
+            new_pool = scatter_rows_to_blocks(
+                pool_cache, mutated["cache"], slots, dest_blocks, prompt_lens,
+                bt, shardings=cache_shardings,
+            )
+            d_tables = d_tables.at[slots].set(group_tables)
+            rem0 = budgets - 1
+            fin0 = (rem0 <= 0) | ((eos_id >= 0) & (first == eos_id))
+            d_tokens = d_tokens.at[slots].set(first)
+            d_pos = d_pos.at[slots].set(prompt_lens)
+            d_temps = d_temps.at[slots].set(temps)
+            d_topks = d_topks.at[slots].set(top_ks)
+            d_finished = d_finished.at[slots].set(fin0)
+            d_remaining = d_remaining.at[slots].set(rem0)
+            rng_data = rng_data.at[slots].set(jax.random.key_data(new_rngs))
+            return (new_pool, first, fin0, d_tables, d_tokens, d_pos, d_temps,
+                    d_topks, d_finished, d_remaining, rng_data)
+
+        if self.mesh is None:
+            return _shared_jit(module, "paged_cached_admit",
+                               lambda: jax.jit(admit_fn, donate_argnums=(0,)))
+        row, rep = self._row_sharding, self._rep_sharding
+        tab = self._table_sharding
+        return jax.jit(
+            admit_fn, donate_argnums=(0,),
+            in_shardings=(self._cache_shardings, self._param_shardings,
+                          rep, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep,
+                          tab, row, row, row, row, row, row, row, rep),
+            out_shardings=(self._cache_shardings, rep, rep, tab,
+                           row, row, row, row, row, row, row),
+        )
+
     def _prefill_len(self, request: Request) -> int:
         """Scheduler probe: prompt tokens admission would actually prefill for
         this request right now (its uncached suffix) — the grouping key for
@@ -857,7 +1176,29 @@ class ServingEngine:
         }
         for dtype, n in tree_bytes_by_dtype(self._cache).items():
             stats[f"slot_pool_bytes/{dtype}"] = n
-        if self.prefix_cache is not None:
+        if self.paged:
+            # paged mode: ``slot_pool_bytes`` above IS the block pool (the
+            # engine's cache tree holds it), so the block_pool/ gauges report
+            # the allocator's view. Invariant: free + resident (trie) +
+            # private (slot-held) == total (tests/test_paged_kv.py).
+            alloc = self._allocator
+            base = (self.prefix_cache.memory_stats()
+                    if self.prefix_cache is not None else {})
+            resident = int(base.get("blocks_resident", 0))
+            for k, v in {
+                "pool_bytes": stats["slot_pool_bytes"],
+                "block_tokens": self._block_tokens,
+                "blocks_total": alloc.num_blocks,
+                "blocks_free": alloc.free_count,
+                "blocks_resident": resident,
+                "blocks_private": alloc.owned_count - resident,
+                "blocks_pinned": int(base.get("blocks_pinned", 0)),
+                "blocks_evictable": int(base.get("blocks_evictable", 0)),
+                "blocks_stranded": int(base.get("blocks_stranded", 0)),
+                "fragmentation": base.get("fragmentation", 0.0),
+            }.items():
+                stats[f"block_pool/{k}"] = v
+        elif self.prefix_cache is not None:
             for k, v in self.prefix_cache.memory_stats().items():
                 stats[f"block_pool/{k}"] = v
         for i, dev in enumerate(jax.local_devices()):
@@ -909,7 +1250,20 @@ class ServingEngine:
                          self.max_len - plen)
             remaining.append(max(0, budget - len(out.tokens)))
         decode_remaining = sum(remaining)
-        capacity = decode_remaining + free * (self.max_len - 1)
+        if self.paged:
+            # a free slot is only worth what the block pool can back: the
+            # optimistic free-slot term is capped by blocks_free * bt. Still
+            # monotone non-increasing as slots fill — an admission moves
+            # budget tokens into decode_remaining while shrinking BOTH cap
+            # operands by at least that much (budget <= max_len - 1 and
+            # budget <= reserved_blocks * bt).
+            blocks_free = self._allocator.free_count
+            capacity = decode_remaining + min(
+                free * (self.max_len - 1),
+                blocks_free * self._block_tokens,
+            )
+        else:
+            capacity = decode_remaining + free * (self.max_len - 1)
         rate = self.metrics.tokens_per_sec()
         exhaustion = capacity / rate if rate > 0 else None
         if free > 0:
@@ -918,7 +1272,7 @@ class ServingEngine:
             slot_free_s = min(remaining) * len(remaining) / rate
         else:
             slot_free_s = None
-        return {
+        out = {
             "slots_free": free,
             "queue_depth": self.scheduler.queue_depth,
             "admissible_requests": max(0, free - self.scheduler.queue_depth),
@@ -928,6 +1282,17 @@ class ServingEngine:
             "seconds_to_exhaustion": exhaustion,
             "est_slot_free_s": slot_free_s,
         }
+        if self.paged:
+            # paged headroom gauges (serve_top's block-pool occupancy bars):
+            # free blocks, and the observed private-blocks-per-active-request
+            # — the ragged workload's real per-request footprint, vs the
+            # full-context blocks_per_slot a slot-pool engine always pays
+            active = self.active_slots
+            priv = sum(len(p) for p in self._slot_priv)
+            out["blocks_free"] = blocks_free
+            out["blocks_per_request_est"] = (
+                priv / active if active else float(self._blocks_per_slot))
+        return out
 
     # ------------------------------------------------------------ engine loop
     def step(self) -> list[RequestOutput]:
@@ -951,15 +1316,20 @@ class ServingEngine:
         self._step_count += 1
         if n_active:
             poison = self._poison_mask()
-            (self._cache, nxt, self._d_pos, self._d_remaining, fin,
-             self._rng_data, ok) = self._dispatch(
-                self._compile_key("step"), self._step_fn,
+            step_args = (
                 self._cache, self.params, self._d_tokens, self._d_pos,
                 self._d_temps, self._d_topks, self._rng_data, self._d_finished,
                 self._d_remaining,
                 self._no_poison if poison is None else jnp.asarray(poison),
                 self._d_eos,
             )
+            if self.paged:
+                # tables ride as data (not donated): decode reads through
+                # them but only admission/release rewrites them
+                step_args += (self._d_tables,)
+            (self._cache, nxt, self._d_pos, self._d_remaining, fin,
+             self._rng_data, ok) = self._dispatch(
+                self._compile_key("step"), self._step_fn, *step_args)
             self._d_tokens, self._d_finished = nxt, fin
             self.metrics.dispatch_depth.observe(len(self._inflight) + 1)
             entry = _Inflight(
@@ -1584,17 +1954,26 @@ class ServingEngine:
                     for r in group
                 ]
                 if any(m.tokens for m in matches):
-                    self._admit_group_cached(group, matches, finished)
+                    if not self._admit_group_cached(group, matches, finished):
+                        return  # block-pool backpressure: group requeued
                     continue
                 for r in group:
                     if r.cache_prefix and not r.resume_tokens:
                         self.metrics.prefix_misses.inc()
             # all-miss (or cache off): the plain admission program — with the
             # prefix cache disabled this path is bit-for-bit the pre-cache one
-            self._admit_group(group, finished)
+            if not self._admit_group(group, finished):
+                return  # block-pool backpressure: group requeued
 
     def _admit_group(self, group: list[Request],
-                     finished: list[RequestOutput]) -> None:
+                     finished: list[RequestOutput]) -> bool:
+        reservation = None
+        if self.paged:
+            # reserve BEFORE touching slots: on exhaustion the group goes
+            # back to the queue front untouched (backpressure, not a crash)
+            reservation = self._reserve_blocks(group, None)
+            if reservation is None:
+                return False
         nb = len(group)
         slots = [self._free.popleft() for _ in group]
         bucket = self.scheduler.bucket_for(max(r.prefill_len for r in group))
@@ -1631,25 +2010,43 @@ class ServingEngine:
             rng_rows.append(jax.random.key_data(key))
             if k:
                 self.metrics.replayed_tokens.inc(plen + k)
-        (self._cache, first, fin0, self._d_tokens, self._d_pos,
-         self._d_temps, self._d_topks, self._d_finished,
-         self._d_remaining, self._rng_data) = self._dispatch(
-            self._compile_key("admit", bucket, nb), self._admit_fn,
-            self._cache, self.params, jnp.asarray(padded),
-            jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
-            jnp.asarray(temps), jnp.asarray(topks),
-            jnp.stack(rng_rows), jnp.asarray(budgets),
-            self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
-            self._d_finished, self._d_remaining, self._rng_data,
-            self._d_eos,
-        )
+        if self.paged:
+            tables_np, dest_np = self._commit_reservation(
+                reservation, group, None, slots)
+            (self._cache, first, fin0, self._d_tables, self._d_tokens,
+             self._d_pos, self._d_temps, self._d_topks, self._d_finished,
+             self._d_remaining, self._rng_data) = self._dispatch(
+                self._compile_key("admit", bucket, nb), self._admit_fn,
+                self._cache, self.params, jnp.asarray(padded),
+                jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(topks),
+                jnp.stack(rng_rows), jnp.asarray(budgets),
+                jnp.asarray(dest_np), jnp.asarray(tables_np),
+                self._d_tables, self._d_tokens, self._d_pos, self._d_temps,
+                self._d_topks, self._d_finished, self._d_remaining,
+                self._rng_data, self._d_eos,
+            )
+        else:
+            (self._cache, first, fin0, self._d_tokens, self._d_pos,
+             self._d_temps, self._d_topks, self._d_finished,
+             self._d_remaining, self._rng_data) = self._dispatch(
+                self._compile_key("admit", bucket, nb), self._admit_fn,
+                self._cache, self.params, jnp.asarray(padded),
+                jnp.asarray(np.asarray(slots, np.int32)), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(topks),
+                jnp.stack(rng_rows), jnp.asarray(budgets),
+                self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
+                self._d_finished, self._d_remaining, self._rng_data,
+                self._d_eos,
+            )
         self.metrics.prefill_tokens.inc(int(lens.sum()))
         self.metrics.admit_batch_size.observe(nb)
         self._finish_admit(group, None, slots, (first, fin0), finished, bucket)
+        return True
 
     def _admit_group_cached(self, group: list[Request],
                             matches: list[PrefixMatch],
-                            finished: list[RequestOutput]) -> None:
+                            finished: list[RequestOutput]) -> bool:
         pc = self.prefix_cache
         nb = len(group)
         # context guard: `dynamic_update_slice` CLAMPS out-of-range starts, so
@@ -1670,6 +2067,14 @@ class ServingEngine:
             keep = max(0, (self.max_len - bucket) // pc.block_tokens)
             for i in over:
                 matches[i] = pc.trim(matches[i], keep)
+        reservation = None
+        if self.paged:
+            # reservation AFTER the trim fixed point: aliased counts must
+            # reflect the matches admission will actually use. On failure the
+            # pins are released and the group requeued inside _reserve_blocks.
+            reservation = self._reserve_blocks(group, matches)
+            if reservation is None:
+                return False
         slots = [self._free.popleft() for _ in group]
         padded = np.zeros((nb, bucket), np.int32)
         suffix_lens = np.zeros(nb, np.int32)
@@ -1699,24 +2104,148 @@ class ServingEngine:
                 self.metrics.prefix_tokens_reused.inc(m.tokens)
             elif request.cache_prefix:
                 self.metrics.prefix_misses.inc()
-        (self._cache, first, fin0, self._d_tokens, self._d_pos,
-         self._d_temps, self._d_topks, self._d_finished,
-         self._d_remaining, self._rng_data) = self._dispatch(
-            self._compile_key("cached_admit", bucket, nb), self._cached_admit_fn,
-            self._cache, self.params, pc.pool, jnp.asarray(tables),
-            jnp.asarray(cached_lens), jnp.asarray(padded),
-            jnp.asarray(suffix_lens),
-            jnp.asarray(np.asarray(slots, np.int32)),
-            jnp.asarray(temps), jnp.asarray(topks), jnp.stack(rng_rows),
-            jnp.asarray(budgets), self._d_tokens, self._d_pos, self._d_temps,
-            self._d_topks, self._d_finished, self._d_remaining,
-            self._rng_data, self._d_eos,
-        )
+        if self.paged:
+            # the reservation's tables carry the aliased trie blocks up front
+            # and the slot's fresh private blocks after — they serve as BOTH
+            # the gather view (aliased prefix, zero-copy) and the decode
+            # table; dest drops the aliased region so the scatter writes only
+            # the suffix's blocks
+            tables_np, dest_np = self._commit_reservation(
+                reservation, group, matches, slots)
+            (self._cache, first, fin0, self._d_tables, self._d_tokens,
+             self._d_pos, self._d_temps, self._d_topks, self._d_finished,
+             self._d_remaining, self._rng_data) = self._dispatch(
+                self._compile_key("cached_admit", bucket, nb),
+                self._cached_admit_fn,
+                self._cache, self.params, jnp.asarray(tables_np),
+                jnp.asarray(cached_lens), jnp.asarray(padded),
+                jnp.asarray(suffix_lens),
+                jnp.asarray(np.asarray(slots, np.int32)),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.stack(rng_rows),
+                jnp.asarray(budgets), jnp.asarray(dest_np),
+                jnp.asarray(tables_np),
+                self._d_tables, self._d_tokens, self._d_pos, self._d_temps,
+                self._d_topks, self._d_finished, self._d_remaining,
+                self._rng_data, self._d_eos,
+            )
+        else:
+            (self._cache, first, fin0, self._d_tokens, self._d_pos,
+             self._d_temps, self._d_topks, self._d_finished,
+             self._d_remaining, self._rng_data) = self._dispatch(
+                self._compile_key("cached_admit", bucket, nb),
+                self._cached_admit_fn,
+                self._cache, self.params, pc.pool, jnp.asarray(tables),
+                jnp.asarray(cached_lens), jnp.asarray(padded),
+                jnp.asarray(suffix_lens),
+                jnp.asarray(np.asarray(slots, np.int32)),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.stack(rng_rows),
+                jnp.asarray(budgets), self._d_tokens, self._d_pos,
+                self._d_temps, self._d_topks, self._d_finished,
+                self._d_remaining, self._rng_data, self._d_eos,
+            )
         # only the uncached suffixes hit the model — that delta is the point
         self.metrics.prefill_tokens.inc(int(suffix_lens.sum()))
         self.metrics.admit_batch_size.observe(nb)
         self._finish_admit(group, matches, slots, (first, fin0), finished,
                            bucket)
+        return True
+
+    # ------------------------------------------------------- paged block pool
+    def _reserve_blocks(
+        self, group: list[Request], matches: list[PrefixMatch] | None
+    ) -> list[tuple[int, list[int]]] | None:
+        """All-or-nothing block reservation for one admission group. Each
+        request needs blocks covering ``min(prompt + max_new_tokens,
+        max_len)`` tokens minus its trie-aliased prefix — reserved UP FRONT
+        so mid-decode writes can never find the pool empty. On shortfall,
+        evictable trie blocks are reclaimed; if still short, pins are dropped
+        and the group goes back to the queue FRONT in its original order:
+        backpressure, never a crash, and FIFO order is preserved. Returns
+        ``[(aliased_blocks, private_block_ids)]`` per request, or None."""
+        alloc, bt = self._allocator, self._block_tokens
+        needs: list[tuple[int, int]] = []
+        for i, request in enumerate(group):
+            m = matches[i] if matches is not None else None
+            aliased = (m.tokens // bt) if m is not None else 0
+            extent = min(
+                len(request.prompt) + int(request.params.max_new_tokens),
+                self.max_len,
+            )
+            n_res = -(-extent // bt)  # ceil: the frontier block counts whole
+            needs.append((aliased, max(0, n_res - aliased)))
+        total = sum(n for _, n in needs)
+        if alloc.free_count < total and self.prefix_cache is not None:
+            self.prefix_cache.reclaim(total - alloc.free_count)
+        if alloc.free_count < total:
+            if matches is not None:
+                for m in matches:
+                    if m.nodes:
+                        self.prefix_cache.release(m)
+            for request in reversed(group):
+                self.scheduler.requeue(request)
+            return None
+        return [(aliased, alloc.alloc(n) or []) for aliased, n in needs]
+
+    def _commit_reservation(
+        self, reservation: list[tuple[int, list[int]]], group: list[Request],
+        matches: list[PrefixMatch] | None, slots: list[int],
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Materialize a reservation into the admission call's table/dest
+        arrays and the slot mirrors. Table rows: trie-aliased blocks first,
+        then the slot's private blocks; everything past the reservation
+        points at ``num_blocks`` so a stray read clamps harmlessly and a
+        stray write drops. ``dest`` marks ONLY the blocks the admission
+        scatter must fill — ``[aliased, ceil(prefill_len / bt))`` — the
+        aliased prefix stays untouched (zero-copy) and reserved decode
+        blocks are filled in place by the decode step before any read."""
+        bt = self._block_tokens
+        nb = len(group)
+        sentinel = self._allocator.num_blocks
+        tables = np.full((nb, self._blocks_per_slot), sentinel, np.int32)
+        dest = np.full((nb, self._blocks_per_slot), sentinel, np.int32)
+        for i, (request, slot) in enumerate(zip(group, slots)):
+            aliased, priv = reservation[i]
+            if aliased:
+                tables[i, :aliased] = matches[i].block_ids[:aliased]
+            if priv:
+                tables[i, aliased:aliased + len(priv)] = priv
+            n_written = -(-request.prefill_len // bt)
+            dest[i, aliased:n_written] = tables[i, aliased:n_written]
+            self._slot_table_host[slot] = tables[i].copy()
+            self._slot_priv[slot] = list(priv)
+            self._slot_aliased[slot] = aliased
+        return tables, dest
+
+    def _blocks_needed(self, request: Request) -> int:
+        """Pool blocks admitting ``request`` right now would reserve (the
+        capacity probe's per-request price — unpinned, so a later acquire may
+        see a slightly different trie; the reservation re-checks)."""
+        bt = self._block_tokens
+        extent = min(len(request.prompt) + int(request.params.max_new_tokens),
+                     self.max_len)
+        n_res = -(-extent // bt)
+        if (self.prefix_cache is not None and request.cache_prefix
+                and not request.resume_tokens):
+            n_res -= self.prefix_cache.match_len(request.prompt) // bt
+        return max(0, n_res)
+
+    def _paged_capacity(self, requests: list[Request]) -> int:
+        """Scheduler hook (`FIFOScheduler.capacity_fn`): how many of the
+        front-run requests the block pool can seat — free blocks plus what
+        trie eviction could reclaim. Optimistic by one race (an evictable
+        block the group's own acquire then pins): the reservation re-checks
+        and requeues, so the cost is a retry, never a crash."""
+        avail = self._allocator.free_count
+        if self.prefix_cache is not None:
+            avail += int(self.prefix_cache.memory_stats()["blocks_evictable"])
+        n = 0
+        for request in requests:
+            need = self._blocks_needed(request)
+            if need > avail:
+                break
+            avail -= need
+            n += 1
+        return n
 
     def _finish_admit(self, group: list[Request],
                       matches: list[PrefixMatch] | None, slots: list[int],
@@ -1841,9 +2370,28 @@ class ServingEngine:
             # continuation prefill padded to a bigger bucket than a cold
             # prefill of the prompt alone would use, and donated rows must
             # only ever be ones a cold path would have produced.
-            self.prefix_cache.insert(
-                self._slot_req[slot].prompt, self._cache, slot
-            )
+            if self.paged:
+                # zero-copy donation: ownership of the prompt's FULL blocks
+                # moves to the trie (duplicates are freed inside adopt, the
+                # already-aliased prefix just stays the trie's). Blocks at or
+                # past the frontier — anything decode wrote or may still
+                # write from a lagged dispatch — are NEVER adopted; they are
+                # freed by _release_slot once the table row is neutralized.
+                prompt = self._slot_req[slot].prompt
+                n_full = len(prompt) // self._block_tokens
+                aliased = int(self._slot_aliased[slot])
+                if n_full:
+                    self.prefix_cache.adopt(
+                        prompt,
+                        [int(x) for x in self._slot_table_host[slot][:n_full]],
+                        owned_from=aliased,
+                    )
+                    donated = max(0, n_full - aliased)
+                    self._slot_priv[slot] = self._slot_priv[slot][donated:]
+            else:
+                self.prefix_cache.insert(
+                    self._slot_req[slot].prompt, self._cache, slot
+                )
         self._release_slot(slot)
         finished.append(out)
 
@@ -1855,6 +2403,23 @@ class ServingEngine:
         admission's scatter rewrites every per-slot array."""
         if self.prefix_cache is not None and self._slot_match[slot] is not None:
             self.prefix_cache.release(self._slot_match[slot])
+        if self.paged:
+            if self._slot_priv[slot]:
+                self._allocator.free(self._slot_priv[slot])
+            self._slot_priv[slot] = []
+            self._slot_table_host[slot] = None
+            self._slot_aliased[slot] = 0
+            # a CANCELLED slot is not device-finished: dispatches already in
+            # flight — and any issued before the next admission reuses this
+            # slot — would keep writing through the stale table row into
+            # blocks just freed (and possibly handed to a new tenant). Point
+            # the row at num_blocks: paged_decode_update's mode="drop"
+            # scatter then discards the write. In-flight work dispatched
+            # BEFORE this update is still safe by device dispatch order —
+            # its stale writes execute before any re-allocating admission's
+            # scatter can land.
+            self._d_tables = self._d_tables.at[slot].set(
+                jnp.int32(self._allocator.num_blocks))
         self._slot_match[slot] = None
         self._slot_hit[slot] = False
         self._slot_itl[slot] = None

@@ -114,7 +114,7 @@ class PrefixCache:
 
     def __init__(self, cache: Any, max_len: int, block_tokens: int = 16,
                  num_blocks: int | None = None, metrics: Any = None,
-                 shardings: Any = None):
+                 shardings: Any = None, allocator: Any = None):
         block_tokens = int(block_tokens)
         if block_tokens < 1 or block_tokens & (block_tokens - 1):
             raise ValueError(f"block_tokens must be a power of two, got {block_tokens}")
@@ -125,6 +125,22 @@ class PrefixCache:
         self.block_tokens = block_tokens
         self.max_len = int(max_len)
         self.blocks_per_row = self.max_len // block_tokens
+        self.metrics = metrics
+        self._root = _TrieNode((), None, -1)
+        self._tick = 0
+        # ``allocator`` (a `models.kv_cache.BlockAllocator`) switches the trie
+        # to PAGED mode (`docs/serving.md` "Paged KV"): the engine's paged KV
+        # cache IS the pool, so this class owns no device state at all —
+        # donation becomes `adopt` (a host-side ownership move of blocks the
+        # slot already wrote), hits are zero-copy block-table aliases, and
+        # eviction returns blocks to the shared free list via `reclaim`.
+        self.allocator = allocator
+        if allocator is not None:
+            self.num_blocks = int(allocator.num_blocks)
+            self.pool = None
+            self._free = None
+            self._scatter = None
+            return
         if num_blocks is None:
             num_blocks = 2 * self.blocks_per_row * int(cache_batch_size(cache))
         self.num_blocks = int(num_blocks)
@@ -137,10 +153,7 @@ class PrefixCache:
         # scatter's output layout; None is the single-device pool, unchanged.
         self.pool = make_block_pool(cache, self.num_blocks, block_tokens,
                                     shardings=shardings)
-        self.metrics = metrics
-        self._root = _TrieNode((), None, -1)
         self._free: deque[int] = deque(range(self.num_blocks))
-        self._tick = 0
         # donation scatter: ONE compiled program for any number of new blocks
         # (skipped blocks ride as dropped out-of-range ids, not shapes)
         self._scatter = jax.jit(
@@ -214,6 +227,8 @@ class PrefixCache:
         block ``j``, so a partial prefix is still fully useful and nothing
         past the gap could ever be matched.
         """
+        if self.allocator is not None:
+            raise RuntimeError("paged mode donates via adopt(), not insert()")
         n_blocks = min(len(prompt) // self.block_tokens, self.blocks_per_row)
         dest = np.full(self.blocks_per_row, self.num_blocks, np.int32)
         node, new = self._root, 0
@@ -238,7 +253,57 @@ class PrefixCache:
                 self.metrics.prefix_blocks_donated.inc(new)
         return new
 
+    def adopt(self, prompt: list[int], block_ids: list[int],
+              owned_from: int) -> int:
+        """Paged-mode donation: transfer ownership of a retired slot's full
+        prompt blocks into the trie with ZERO device work — prefill already
+        wrote them in place in the shared pool, so the trie simply starts
+        pointing at them. ``block_ids[j]`` is the pool block holding prompt
+        block ``j`` (the leading row of the slot's block table); blocks
+        before ``owned_from`` are the admission-time aliased prefix (already
+        trie-owned — just touched to refresh LRU), blocks at/after it are
+        slot-private. A private block whose token key is already resident is
+        a duplicate raced in by a concurrent retire and goes straight back
+        to the shared allocator. Returns how many blocks were newly adopted.
+        """
+        n_blocks = min(len(prompt) // self.block_tokens, self.blocks_per_row)
+        node, new = self._root, 0
+        for j in range(n_blocks):
+            key = tuple(prompt[j * self.block_tokens:(j + 1) * self.block_tokens])
+            child = node.children.get(key)
+            if child is None:
+                if j < owned_from:
+                    # the aliased prefix is pinned until release(); eviction
+                    # cannot have removed it mid-flight
+                    raise RuntimeError(
+                        f"pinned prefix block {j} missing from trie at adopt")
+                child = _TrieNode(key, node, int(block_ids[j]))
+                node.children[key] = child
+                new += 1
+            elif j >= owned_from:
+                self.allocator.free([int(block_ids[j])])
+            self._touch(child)
+            node = child
+        if new and self.metrics is not None:
+            self.metrics.prefix_blocks_donated.inc(new)
+        return new
+
     # ------------------------------------------------------------------ eviction
+    def reclaim(self, n: int) -> int:
+        """Paged-mode eviction: pop up to ``n`` unpinned LRU leaves and hand
+        their blocks back to the shared allocator (admission calls this when
+        the free list cannot cover a new request's block reservation).
+        Returns how many blocks were actually freed — fewer than ``n`` means
+        everything still resident is pinned or interior."""
+        freed = 0
+        while freed < n:
+            block_id = self._evict_one()
+            if block_id is None:
+                break
+            self.allocator.free([block_id])
+            freed += 1
+        return freed
+
     def _alloc(self) -> int | None:
         if self._free:
             return self._free.popleft()
@@ -273,8 +338,12 @@ class PrefixCache:
     # ----------------------------------------------------------------- inspection
     @property
     def cached_blocks(self) -> int:
-        """Blocks currently resident in the trie (eviction hands a reclaimed
-        block straight to its new tenant, so allocated == resident)."""
+        """Blocks currently resident in the trie (slot mode: eviction hands a
+        reclaimed block straight to its new tenant, so allocated == resident;
+        paged mode: counted from the trie, the shared allocator also carries
+        slot-private blocks this class does not see)."""
+        if self._free is None:
+            return self.node_count()
         return self.num_blocks - len(self._free)
 
     def node_count(self) -> int:
@@ -287,14 +356,20 @@ class PrefixCache:
 
     @property
     def blocks_free(self) -> int:
-        """Pool blocks on the free list (never yet allocated, or returned by
-        an explicit clear — eviction recycles in place and bypasses it)."""
+        """Pool blocks on the free list (slot mode: never yet allocated, or
+        returned by an explicit clear — eviction recycles in place and
+        bypasses it; paged mode: the shared allocator's free count)."""
+        if self._free is None:
+            return self.allocator.free_count
         return len(self._free)
 
     @property
     def pool_nbytes(self) -> int:
         """Exact device bytes of the block pool (constant after allocation —
-        the pool is never resized, only rewritten in place)."""
+        the pool is never resized, only rewritten in place). Zero in paged
+        mode: the pool is the engine's paged KV cache and accounted there."""
+        if self.pool is None:
+            return 0
         return tree_nbytes(self.pool)
 
     def memory_stats(self) -> dict[str, int | float]:
@@ -326,7 +401,7 @@ class PrefixCache:
         return {
             "pool_bytes": self.pool_nbytes,
             "blocks_total": self.num_blocks,
-            "blocks_free": len(self._free),
+            "blocks_free": self.blocks_free,
             "blocks_resident": resident,
             "blocks_pinned": pinned,
             "blocks_evictable": evictable,

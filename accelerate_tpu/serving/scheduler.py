@@ -49,6 +49,12 @@ class FIFOScheduler:
         # tracer so every QUEUED edge — fresh acceptance or watchdog requeue —
         # is stamped where the queue actually changes
         self.tracer = NULL_TRACER
+        # paged-KV capacity hook (set by the engine when paged_kv is on):
+        # maps the front run's requests to how many of them the block pool can
+        # actually seat right now. Admission is gated on BLOCKS, not just free
+        # slots — a free slot with no blocks behind it would crash mid-decode,
+        # so the gate lives here where the run is sized.
+        self.capacity_fn = None
         self._queue: deque[Request] = deque()
 
     def bucket_for(self, prompt_len: int) -> int:
@@ -133,6 +139,12 @@ class FIFOScheduler:
             if n >= max_n or self._run_key(r) != head_key:
                 break
             n += 1
+        if n and self.capacity_fn is not None:
+            # paged mode: shrink the run to what the block pool can seat —
+            # the hook sees the actual front requests so it can price each
+            # one's reservation (prompt + budget, minus any aliased prefix)
+            n = max(0, min(n, int(self.capacity_fn(
+                [self._queue[i] for i in range(n)]))))
         return n
 
     def pop_run(self, n: int) -> list[Request]:

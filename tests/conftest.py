@@ -70,6 +70,12 @@ def pytest_configure(config):
         "docs/observability.md \"Continuous telemetry\") — run standalone "
         "with `pytest -m telemetry`",
     )
+    config.addinivalue_line(
+        "markers",
+        "paged: paged block-table KV serving tests (engine ``paged_kv=``, "
+        "models/kv_cache.py BlockAllocator — docs/serving.md \"Paged KV\") — "
+        "run standalone with `pytest -m paged`",
+    )
 
 
 @pytest.fixture

@@ -330,6 +330,84 @@ def test_snapshot_restore_is_crash_exact(model, tmp_path):
     assert {rid: o.tokens for rid, o in final.items()} == refs
 
 
+@pytest.mark.paged
+def test_resume_from_journal_paged_crash_exact(model, tmp_path):
+    """Journal kill-and-resume with PAGED KV (+ prefix cache + pipelining):
+    `resume()` re-prefills every surviving stream into freshly allocated
+    blocks — no block id survives the crash, only tokens do — and parity
+    must hold with the pool accounting clean after the drain."""
+    module, params = model
+
+    def build(jpath):
+        return ServingEngine(
+            module, params, max_concurrency=2, prompt_buckets=(16, 32),
+            pipeline_depth=2, paged_kv=True,
+            prefix_cache=PrefixCacheConfig(block_tokens=16), journal=jpath)
+
+    base = _prompts(7, (17, 23))
+    prompts = base + [list(base[0]), list(base[1])]  # duplicates: cache hits
+    reqs = _mixed_requests(prompts, 8)
+    refs = _refs(module, params, reqs)
+
+    jpath = tmp_path / "requests.journal"
+    a = build(jpath)
+    for r in reqs:
+        assert a.submit(Request(list(r.prompt), r.params)).accepted
+    pre = {}
+    for _ in range(5):
+        for out in a.step():
+            pre[out.request_id] = out
+    del a
+
+    b = build(jpath)
+    report = b.resume()
+    final = dict(report.completed)
+    final.update(pre)
+    _drive(b, final)
+    assert {rid: o.tokens for rid, o in final.items()} == refs
+    mem = b.memory_stats()
+    assert mem["block_pool/blocks_pinned"] == 0
+    assert mem["block_pool/blocks_private"] == 0
+    assert (mem["block_pool/blocks_free"] + mem["block_pool/blocks_resident"]
+            == mem["block_pool/blocks_total"])
+
+
+@pytest.mark.paged
+def test_snapshot_restore_paged_crash_exact(model, tmp_path):
+    """Snapshot/restore with paged KV and no trie: the same crash-exact bar,
+    and the restored engine's pool must drain back to fully free."""
+    module, params = model
+    reqs = _mixed_requests(_prompts(3, (5, 9, 14)), 12)
+    reqs[0] = Request(reqs[0].prompt, SamplingParams(max_new_tokens=3, seed=100))
+    refs = _refs(module, params, reqs)
+
+    def build():
+        return ServingEngine(module, params, max_concurrency=2,
+                             prompt_buckets=(16,), paged_kv=True)
+
+    a = build()
+    for r in reqs:
+        assert a.submit(Request(list(r.prompt), r.params)).accepted
+    pre = {}
+    for _ in range(5):
+        for out in a.step():
+            pre[out.request_id] = out
+    snap = tmp_path / "engine.snap"
+    for out in a.snapshot(snap):
+        pre[out.request_id] = out
+    # the abandoned engine's reservations die with it; the fresh one below
+    # re-reserves from its own full pool
+    b = build()
+    report = b.resume(snap)
+    assert not report.expired
+    final = dict(report.completed)
+    final.update(pre)
+    _drive(b, final)
+    assert {rid: o.tokens for rid, o in final.items()} == refs
+    mem = b.memory_stats()
+    assert mem["block_pool/blocks_free"] == mem["block_pool/blocks_total"]
+
+
 def test_resume_requires_idle_engine(model, tmp_path):
     module, params = model
     jpath = tmp_path / "requests.journal"

@@ -141,15 +141,21 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit):
     assert engine.metrics.prefix_evictions.value > 0
 
 
-def test_capacity_headroom_monotone_as_slots_fill(model):
+@pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])
+def test_capacity_headroom_monotone_as_slots_fill(model, paged):
+    """Headroom is monotone non-increasing as slots fill — in BOTH KV modes
+    (the paged block-gated capacity must never report more room after an
+    admission than before it)."""
     module, params = model
     engine = ServingEngine(module, params, max_concurrency=4,
-                           prompt_buckets=(8,), max_queue=8)
+                           prompt_buckets=(8,), max_queue=8, paged_kv=paged)
     idle = engine.capacity_headroom()
     assert idle["admissible_requests"] == 4
     assert idle["seconds_to_exhaustion"] is None  # no rate yet, never inf
     assert idle["est_slot_free_s"] == 0.0
     assert idle["token_capacity_remaining"] == 4 * (engine.max_len - 1)
+    if paged:
+        assert idle["blocks_free"] == engine._allocator.num_blocks
     seen = [idle]
     for i in range(4):
         assert engine.submit(Request(
@@ -163,6 +169,8 @@ def test_capacity_headroom_monotone_as_slots_fill(model):
         assert cur["admissible_requests"] <= prev["admissible_requests"]
         assert (cur["token_capacity_remaining"]
                 <= prev["token_capacity_remaining"])
+        if paged:
+            assert cur["blocks_free"] <= prev["blocks_free"]
     full = seen[-1]
     assert full["seconds_to_exhaustion"] is not None  # decoding → rate > 0
     assert full["est_slot_free_s"] is not None and full["est_slot_free_s"] > 0

@@ -54,6 +54,17 @@ Env knobs:
                         set 1 to bisect a failure against synchronous dispatch)
   CHAOS_PREFIX          1 (default) serves through the prefix cache; 0 = off
   CHAOS_PREFIX_BLOCKS   prefix pool size in blocks (default 6: forces eviction)
+  CHAOS_PAGED           1 replays through PAGED KV (``paged_kv=True``,
+                        docs/serving.md "Paged KV"): block-gated admission,
+                        zero-copy prefix aliasing, and block reclaim all run
+                        under the same chaos, with the same zero-lost /
+                        zero-drift bar PLUS full pool reclamation — after the
+                        drain (and, with the trie on, after evicting every
+                        resident block) ``blocks_free`` must return to its
+                        initial value; a single leaked or double-freed block
+                        fails the replay. Works with the crash scenarios too
+                        (the resumed engine re-prefills into fresh blocks).
+                        Default 0: the slot-pool KV path
   CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
                         generate; 0 skips the reference pass
   CHAOS_MESH            "DxM" (e.g. "2x2") replays through a mesh-sharded
@@ -114,13 +125,28 @@ def _assert_steady_state(engine) -> dict:
         f"leaked slots after drain: {mem}"
     assert mem["queue_depth"] == 0 and mem["inflight_dispatches"] == 0, \
         f"work left after drain: {mem}"
-    if engine.prefix_cache is not None:
+    if "block_pool/blocks_total" in mem:  # prefix trie and/or paged pool
         assert mem["block_pool/blocks_pinned"] == 0, \
             f"stuck block pins after drain: {mem}"
+        assert mem.get("block_pool/blocks_private", 0) == 0, \
+            f"retired slots still hold private blocks: {mem}"
         assert (mem["block_pool/blocks_free"]
                 + mem["block_pool/blocks_resident"]
+                + mem.get("block_pool/blocks_private", 0)
                 == mem["block_pool/blocks_total"]), \
             f"block accounting inconsistent after drain: {mem}"
+    if getattr(engine, "paged", False):
+        # full reclamation: every resident (trie-donated) block must still be
+        # evictable, and evicting them all returns the pool to its initial
+        # fully-free state — the paged acceptance bar. The replay is over, so
+        # mutating the trie here costs nothing.
+        if engine.prefix_cache is not None:
+            engine.prefix_cache.reclaim(
+                int(mem["block_pool/blocks_resident"]))
+        mem = engine.memory_stats()
+        assert (mem["block_pool/blocks_free"]
+                == mem["block_pool/blocks_total"]), \
+            f"pool not fully reclaimed after drain + evict-all: {mem}"
     assert head["slots_free"] == engine.max_concurrency, \
         f"headroom not restored after drain: {head}"
     assert head["admissible_requests"] == engine.max_concurrency, \
@@ -129,6 +155,8 @@ def _assert_steady_state(engine) -> dict:
         "slot_pool_bytes": mem["slot_pool_bytes"],
         "blocks_pinned": mem.get("block_pool/blocks_pinned", 0),
         "blocks_resident": mem.get("block_pool/blocks_resident", 0),
+        "blocks_free": mem.get("block_pool/blocks_free", 0),
+        "blocks_total": mem.get("block_pool/blocks_total", 0),
         "fragmentation": mem.get("block_pool/fragmentation", 0.0),
         "admissible_requests": head["admissible_requests"],
     }
@@ -150,6 +178,7 @@ def run(
     verify_parity: bool = True,
     mesh=None,
     trace_path: str | None = None,
+    paged: bool = False,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     (with ``verify_parity``) zero token drift against solo generate; return
@@ -206,7 +235,10 @@ def run(
                       if prefix_cache else False),
         mesh=mesh,
         tracer=tracer,
+        paged_kv=paged,
     )
+    blocks_free_initial = (engine.memory_stats()["block_pool/blocks_free"]
+                           if paged else None)
     slo_plain = SLOSpec(name="plain")
     slo_deadline = SLOSpec(name="deadline")
 
@@ -242,6 +274,10 @@ def run(
     lost = sorted(set(submitted) - set(terminal))
     assert not lost, f"lost requests (accepted but no terminal output): {lost}"
     steady = _assert_steady_state(engine)
+    if paged:
+        assert steady["blocks_free"] == blocks_free_initial, \
+            (f"block pool did not return to its initial state: "
+             f"{steady['blocks_free']} != {blocks_free_initial}")
 
     # parity drift: every cleanly finished request — whether its prefill came
     # cold, from cached blocks, after an eviction, or via a watchdog
@@ -292,6 +328,8 @@ def run(
             "seed": seed,
             "pipeline_depth": pipeline_depth,
             "prefix_cache": bool(prefix_cache),
+            "paged_kv": bool(paged),
+            "blocks_free_initial": blocks_free_initial,
             "mesh": f"{engine.mesh_shape[0]}x{engine.mesh_shape[1]}"
                     if engine.mesh is not None else None,
             "compile_count": m.compile_count.value,
@@ -344,6 +382,7 @@ def _crash_child() -> None:
         prefix_cache=(PrefixCacheConfig(num_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6))
                       if _env_int("CHAOS_PREFIX", 1) else False),
         journal=os.environ["CHAOS_JOURNAL"],
+        paged_kv=bool(_env_int("CHAOS_PAGED", 0)),
     )
     if os.environ.get("CHAOS_SCENARIO") == "sigterm":
         install_serving_preemption_handler(
@@ -381,6 +420,7 @@ def run_crash(
     workdir: str | None = None,
     verify_parity: bool = True,
     trace_path: str | None = None,
+    paged: bool = False,
 ) -> dict:
     """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
     fresh engine from what survived on disk, and assert zero lost accepted
@@ -419,6 +459,7 @@ def run_crash(
         CHAOS_CONCURRENCY=str(concurrency), CHAOS_SEED=str(seed),
         CHAOS_DEPTH=str(pipeline_depth), CHAOS_PREFIX=str(int(prefix_cache)),
         CHAOS_PREFIX_BLOCKS=str(prefix_blocks), CHAOS_GRACE=str(grace_s),
+        CHAOS_PAGED=str(int(paged)),
         JAX_PLATFORMS="cpu",
     )
     t0 = time.perf_counter()
@@ -475,6 +516,7 @@ def run_crash(
                       if prefix_cache else False),
         journal=journal,
         tracer=tracer,
+        paged_kv=paged,
     )
     report = engine.resume(source)
     # terminal outcome per accepted rid: child finishes from the journal,
@@ -546,6 +588,7 @@ def run_crash(
             "seed": seed,
             "pipeline_depth": pipeline_depth,
             "prefix_cache": bool(prefix_cache),
+            "paged_kv": bool(paged),
             "finished_pre_crash": len(scan.finishes),
             "resumed_mid_stream": len(report.resumed),
             "restored_queued": len(report.restored),
@@ -580,6 +623,7 @@ def main() -> None:
             grace_s=float(os.environ.get("CHAOS_GRACE", 0.05)),
             verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
             trace_path=os.environ.get("CHAOS_TRACE") or None,
+            paged=bool(_env_int("CHAOS_PAGED", 0)),
         )
         print(json.dumps(summary), flush=True)
         return
@@ -607,6 +651,7 @@ def main() -> None:
         verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
         mesh=mesh,
         trace_path=os.environ.get("CHAOS_TRACE") or None,
+        paged=bool(_env_int("CHAOS_PAGED", 0)),
     )
     print(json.dumps(summary), flush=True)
 

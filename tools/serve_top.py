@@ -129,8 +129,14 @@ def render(point: dict, history: list[dict] | None = None,
     bt = g("serving/mem/block_pool/blocks_total")
     if bt:
         resident = g("serving/mem/block_pool/blocks_resident", 0)
+        private = g("serving/mem/block_pool/blocks_private", 0)
+        # paged engines report private (slot-held) blocks too — the bar is
+        # total pool occupancy; a prefix-cache-only pool has private == 0
+        # and renders exactly as before
+        used = resident + private
+        priv = f" + {private} private" if private else ""
         lines.append(
-            f"blocks {_bar(resident / bt, width)} {resident}/{bt} resident "
+            f"blocks {_bar(used / bt, width)} {resident}/{bt} resident{priv} "
             f"({g('serving/mem/block_pool/blocks_pinned', 0)} pinned, "
             f"{g('serving/mem/block_pool/blocks_evictable', 0)} evictable), "
             f"frag {g('serving/mem/block_pool/fragmentation', 0.0):.2f}, "
