@@ -18,6 +18,8 @@ from .faults import (
     SCOPE_CHECKPOINT_SAVE,
     SCOPE_PREEMPTION,
     SCOPE_SERVING_DECODE,
+    SCOPE_SERVING_DISPATCH,
+    DeviceLostError,
     FaultEvent,
     FaultInjector,
     FaultSpec,
@@ -40,6 +42,7 @@ __all__ = [
     "FaultSpec",
     "FaultEvent",
     "TransientIOError",
+    "DeviceLostError",
     "active_injector",
     "inject",
     "fault_point",
@@ -47,6 +50,7 @@ __all__ = [
     "SCOPE_CHECKPOINT_SAVE",
     "SCOPE_CHECKPOINT_RESTORE",
     "SCOPE_SERVING_DECODE",
+    "SCOPE_SERVING_DISPATCH",
     "SCOPE_PREEMPTION",
     "RetryPolicy",
     "RetryError",

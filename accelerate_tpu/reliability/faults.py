@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 import zlib
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -37,12 +38,15 @@ import numpy as np
 SCOPE_CHECKPOINT_SAVE = "checkpoint.save"
 SCOPE_CHECKPOINT_RESTORE = "checkpoint.restore"
 SCOPE_SERVING_DECODE = "serving.decode"
+SCOPE_SERVING_DISPATCH = "serving.dispatch"
 SCOPE_PREEMPTION = "preemption"
 
 # fault kinds
 KIND_IO_ERROR = "io_error"
 KIND_POISON_NAN = "poison_nan"
 KIND_PREEMPT = "preempt"
+KIND_HANG = "step_hang"
+KIND_DEVICE_ERROR = "device_error"
 
 # sentinel: a poison spec with no explicit slots poisons every active slot
 ALL_SLOTS: tuple[int, ...] = ()
@@ -55,6 +59,15 @@ class TransientIOError(OSError):
     I/O raises, so injected and organic faults exercise the same path."""
 
 
+class DeviceLostError(RuntimeError):
+    """The injected stand-in for a device/runtime failure surfacing from a
+    jitted call (XLA ``RuntimeError`` on a lost TPU core, a preempted donated
+    buffer, ...). A ``RuntimeError`` subclass on purpose: the supervisor's
+    recoverable-exception filter catches exactly what real device loss
+    raises, so injected and organic failures exercise the same restart
+    ladder (`serving/supervisor.py`)."""
+
+
 @dataclass(frozen=True)
 class FaultSpec:
     """One scheduled or probabilistic fault at one scope.
@@ -63,7 +76,8 @@ class FaultSpec:
     point (fully deterministic); ``probability`` fires by a seeded per-spec
     Bernoulli stream (deterministic given the injector seed). ``max_faults``
     caps total firings; ``slots`` narrows a poison fault to specific serving
-    slots (empty = all active slots).
+    slots (empty = all active slots); ``hang_s`` is how long a ``step_hang``
+    fault blocks the dispatching host thread.
     """
 
     scope: str
@@ -72,6 +86,7 @@ class FaultSpec:
     probability: float = 0.0
     max_faults: int | None = None
     slots: tuple[int, ...] = ALL_SLOTS
+    hang_s: float = 0.0
 
     @classmethod
     def io_error(cls, scope: str, at_calls: Sequence[int] = (),
@@ -89,6 +104,26 @@ class FaultSpec:
     def preempt(cls, at_calls: Sequence[int] = (), probability: float = 0.0,
                 scope: str = SCOPE_PREEMPTION) -> "FaultSpec":
         return cls(scope, KIND_PREEMPT, tuple(at_calls), probability, max_faults=1)
+
+    @classmethod
+    def step_hang(cls, at_calls: Sequence[int] = (), hang_s: float = 0.05,
+                  probability: float = 0.0, max_faults: int | None = None,
+                  scope: str = SCOPE_SERVING_DISPATCH) -> "FaultSpec":
+        """A wedged jitted dispatch: the engine's dispatch path blocks for
+        ``hang_s`` seconds (``at_calls`` indexes jitted dispatches — decode
+        steps and admissions alike). The supervisor's hang watchdog must
+        classify the stale heartbeat as a stall and restart."""
+        return cls(scope, KIND_HANG, tuple(at_calls), probability, max_faults,
+                   hang_s=float(hang_s))
+
+    @classmethod
+    def device_error(cls, at_calls: Sequence[int] = (), probability: float = 0.0,
+                     max_faults: int | None = None,
+                     scope: str = SCOPE_SERVING_DISPATCH) -> "FaultSpec":
+        """A lost device: the jitted call raises `DeviceLostError` from the
+        dispatch path, the way XLA surfaces a dead TPU core."""
+        return cls(scope, KIND_DEVICE_ERROR, tuple(at_calls), probability,
+                   max_faults)
 
 
 @dataclass
@@ -183,6 +218,28 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGTERM)
             return True
         return False
+
+    def dispatch_faults(self, scope: str = SCOPE_SERVING_DISPATCH,
+                        sleep=time.sleep) -> float:
+        """Dispatch-path fault point (`ServingEngine._dispatch` evaluates it
+        once per jitted call, so ``at_calls`` indexes dispatches — decode
+        steps and admissions alike). One shared call-index stream covers BOTH
+        kinds: a ``step_hang`` spec blocks the host thread for its ``hang_s``
+        (returned, for assertions) and a ``device_error`` spec raises
+        `DeviceLostError` — the two failure modes a wedged accelerator
+        actually presents. ``sleep`` is injectable so unit tests can observe
+        the hang without paying the wall time."""
+        idx = self._tick(scope)
+        slept = 0.0
+        for _, spec in self._matching(scope, (KIND_HANG, KIND_DEVICE_ERROR), idx):
+            self.fired.append(FaultEvent(scope, idx, spec.kind))
+            if spec.kind == KIND_HANG:
+                sleep(spec.hang_s)
+                slept += spec.hang_s
+            else:
+                raise DeviceLostError(
+                    f"injected device/runtime fault at {scope}#{idx}")
+        return slept
 
     def calls(self, scope: str) -> int:
         """How many times ``scope``'s fault point has been evaluated."""

@@ -17,8 +17,10 @@ from .request import (
     FINISH_LENGTH,
     REJECT_DEADLINE,
     REJECT_DRAINING,
+    REJECT_OVERLOAD,
     REJECT_PROMPT_TOO_LONG,
     REJECT_QUEUE_FULL,
+    REJECT_UNHEALTHY,
     Request,
     RequestOutput,
     SamplingParams,
@@ -26,6 +28,12 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .supervisor import (
+    EngineSupervisor,
+    EngineUnhealthyError,
+    RestartBudget,
+    SupervisorConfig,
+)
 from .telemetry import (
     NULL_TELEMETRY,
     NullTelemetry,
@@ -47,6 +55,10 @@ __all__ = [
     "Counter",
     "Histogram",
     "FIFOScheduler",
+    "EngineSupervisor",
+    "SupervisorConfig",
+    "RestartBudget",
+    "EngineUnhealthyError",
     "Request",
     "RequestOutput",
     "SamplingParams",
@@ -68,4 +80,6 @@ __all__ = [
     "REJECT_PROMPT_TOO_LONG",
     "REJECT_DEADLINE",
     "REJECT_DRAINING",
+    "REJECT_UNHEALTHY",
+    "REJECT_OVERLOAD",
 ]

@@ -693,6 +693,13 @@ class ServingEngine:
         ``jax.profiler.TraceAnnotation`` so the host span lines up with
         device traces; with the default NULL_TRACER a replay dispatch is the
         bare ``fn(*args)`` it always was."""
+        injector = active_injector()
+        if injector is not None:
+            # the serving.dispatch fault point: an active injector may wedge
+            # this call (step_hang) or raise DeviceLostError (device_error) —
+            # exactly what the supervisor's watchdog/restart ladder is proven
+            # against. Production cost stays the one active_injector() load.
+            injector.dispatch_faults()
         compiled = key not in self._compile_seen
         if not compiled and not self.tracer.enabled:
             return fn(*args)
@@ -1469,12 +1476,14 @@ class ServingEngine:
             self.end_drain()
         return outputs
 
-    def abort_all(self) -> list[RequestOutput]:
-        """Hard shutdown: abort every queued and active request with
-        `FINISH_ABORTED` (partial tokens kept for active ones). In-flight
-        device results are discarded unfetched. Output order is the contract
-        tests rely on: first the QUEUE in FIFO submit order, then active
-        slots in ascending slot index."""
+    def abort_all(self, reason: str = FINISH_ABORTED) -> list[RequestOutput]:
+        """Hard shutdown: abort every queued and active request (partial
+        tokens kept for active ones). In-flight device results are discarded
+        unfetched. Output order is the contract tests rely on: first the
+        QUEUE in FIFO submit order, then active slots in ascending slot
+        index. ``reason`` defaults to `FINISH_ABORTED`; the supervisor's
+        fail-loud path passes its own terminal reason so every shed request
+        is distinguishable from an ordinary drain in journal and trace."""
         now = time.perf_counter()
         aborted: list[RequestOutput] = []
         for req in self.scheduler.drain_queue():
@@ -1482,21 +1491,21 @@ class ServingEngine:
             self._slo_never_served(req)
             if self.tracer.enabled:
                 self.tracer.emit(EV_FINISH, req.request_id,
-                                 reason=FINISH_ABORTED,
+                                 reason=reason,
                                  tokens=len(req.resume_tokens), depth=0,
                                  **self._slo_trace_attrs(req.slo))
             if self.journal is not None:
-                self.journal.log_finish(req.request_id, FINISH_ABORTED,
+                self.journal.log_finish(req.request_id, reason,
                                         list(req.resume_tokens))
             aborted.append(RequestOutput(
                 request_id=req.request_id, prompt_len=len(req.prompt),
                 tokens=list(req.resume_tokens),  # a restored request's
-                finish_reason=FINISH_ABORTED,    # recovered prefix is output
+                finish_reason=reason,            # recovered prefix is output
                 arrival_time=req.arrival_time, finish_time=now,
             ))
         for slot in np.flatnonzero(self._active):
             self.metrics.requests_cancelled.inc()
-            self._retire(int(slot), FINISH_ABORTED, now, aborted)
+            self._retire(int(slot), reason, now, aborted)
         if self.tracer.enabled:
             # the cleared entries are never fetched — emit their EV_FETCH as
             # discarded so dispatch/fetch stays balanced in the trace
@@ -1738,6 +1747,7 @@ class ServingEngine:
                 retries=int(e.get("retries", 0)),
                 resume_tokens=toks[:keep],
                 arrival_time=perf_now - waited,
+                priority=int(e.get("priority", 0)),
             )
             if self.tracer.enabled:
                 self.tracer.emit(EV_SUBMIT, rid, prompt_len=plen,

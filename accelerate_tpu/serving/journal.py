@@ -84,6 +84,7 @@ def request_record(request: Any) -> dict[str, Any]:
         },
         "deadline_s": request.deadline_s,
         "cache_prefix": bool(request.cache_prefix),
+        "priority": int(getattr(request, "priority", 0)),
     }
 
 
@@ -132,6 +133,15 @@ class RequestJournal:
     slot between records — the replay frontier granularity vs. write
     amplification trade). ``metrics`` (a `ServingMetrics`) gets
     ``journal_records``/``journal_bytes`` incremented per append.
+
+    ``compact_threshold_bytes`` bounds the file on long runs: once the journal
+    grows past it, the writer runs the offline `compact` in place — always at
+    a record boundary (triggered only after a complete append, never
+    mid-frame), swapping its own file handle around the atomic replace. Each
+    firing counts in ``compactions`` (and ``metrics.journal_compactions``);
+    the threshold then re-arms at ``max(threshold, 2 * compacted size)`` so a
+    journal whose LIVE records already exceed the threshold does not compact
+    on every append. None (default) keeps the append-only behavior.
     """
 
     def __init__(
@@ -141,6 +151,7 @@ class RequestJournal:
         fsync: str = FSYNC_ACCEPT,
         progress_every: int = 8,
         metrics: Any = None,
+        compact_threshold_bytes: int | None = None,
     ):
         if fsync not in (FSYNC_ACCEPT, FSYNC_ALWAYS, FSYNC_NEVER):
             raise ValueError(f"unknown fsync policy {fsync!r}")
@@ -149,6 +160,11 @@ class RequestJournal:
         self.progress_every = max(1, int(progress_every))
         self.metrics = metrics
         self.bytes_written = 0
+        self.compact_threshold_bytes = (
+            None if compact_threshold_bytes is None
+            else max(len(MAGIC) + 1, int(compact_threshold_bytes)))
+        self.compactions = 0
+        self._next_compact_at = self.compact_threshold_bytes
         existing = self.path.exists() and self.path.stat().st_size > 0
         if existing:
             # validate magic AND truncate any torn tail before appending:
@@ -163,6 +179,7 @@ class RequestJournal:
             self._f.write(MAGIC)
             self._f.flush()
             os.fsync(self._f.fileno())
+        self._size = self.path.stat().st_size if existing else len(MAGIC)
 
     # ------------------------------------------------------------- appending
     def _append(self, rec: dict[str, Any]) -> None:
@@ -176,9 +193,34 @@ class RequestJournal:
         ):
             os.fsync(self._f.fileno())
         self.bytes_written += len(frame)
+        self._size += len(frame)
         if self.metrics is not None:
             self.metrics.journal_records.inc()
             self.metrics.journal_bytes.inc(len(frame))
+        if self._next_compact_at is not None and self._size >= self._next_compact_at:
+            self._compact_now()
+
+    def _compact_now(self) -> None:
+        """In-place auto-compaction at a record boundary: the just-finished
+        append is a complete frame, so closing here loses nothing. The handle
+        is reopened on the replaced file before returning — callers never see
+        a closed journal."""
+        self._f.flush()
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:
+            pass
+        self._f.close()
+        RequestJournal.compact(self.path)
+        self._f = open(self.path, "ab")
+        self._size = self.path.stat().st_size
+        self.compactions += 1
+        if self.metrics is not None:
+            self.metrics.journal_compactions.inc()
+        # re-arm above BOTH the configured threshold and twice the live size:
+        # a journal whose live records alone exceed the threshold must not
+        # pay a full rewrite on every subsequent append
+        self._next_compact_at = max(self.compact_threshold_bytes, self._size * 2)
 
     def log_submit(self, request: Any) -> None:
         """WRITE-AHEAD: called after the scheduler accepts and BEFORE the

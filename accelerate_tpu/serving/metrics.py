@@ -136,9 +136,24 @@ class ServingMetrics:
         # tighter progress cadence would shrink)
         self.journal_records = Counter()
         self.journal_bytes = Counter()
+        self.journal_compactions = Counter()
         self.requests_resumed = Counter()
         self.requests_restored = Counter()
         self.replayed_tokens = Counter()
+        # self-healing supervisor telemetry (serving/supervisor.py —
+        # docs/reliability.md "Self-healing"): engine rebuilds performed by
+        # the restart ladder, stalls/NaN-storms the watchdog classified,
+        # admissions shed (brownout REJECT_OVERLOAD + unhealthy
+        # REJECT_UNHEALTHY + fail-loud aborts), brownout episodes entered,
+        # whether a brownout is active right now (0/1 gauge), and cumulative
+        # wall seconds spent browned out
+        self.supervisor_restarts = Counter()
+        self.supervisor_stalls = Counter()
+        self.supervisor_storms = Counter()
+        self.supervisor_shed = Counter()
+        self.supervisor_brownouts = Counter()
+        self.supervisor_brownout_active = 0
+        self.supervisor_time_in_brownout_s = 0.0
         # mesh-sharded serving telemetry (engine ``mesh=``): per-step wall
         # seconds of the cross-device sync probe (a tiny jitted all-reduce
         # over every mesh axis, dispatched+blocked right after the decode
@@ -306,11 +321,20 @@ class ServingMetrics:
             "serving/steps": self.steps.value,
             "serving/journal_records": self.journal_records.value,
             "serving/journal_bytes": self.journal_bytes.value,
+            "serving/journal_compactions": self.journal_compactions.value,
             "serving/requests_resumed": self.requests_resumed.value,
             "serving/requests_restored": self.requests_restored.value,
             "serving/replayed_tokens": self.replayed_tokens.value,
             "serving/tokens_per_sec": self.tokens_per_sec(),
             "serving/compile_count": self.compile_count.value,
+            "supervisor/restarts": self.supervisor_restarts.value,
+            "supervisor/stalls_detected": self.supervisor_stalls.value,
+            "supervisor/storms_detected": self.supervisor_storms.value,
+            "supervisor/shed_requests": self.supervisor_shed.value,
+            "supervisor/brownouts": self.supervisor_brownouts.value,
+            "supervisor/brownout_active": int(self.supervisor_brownout_active),
+            "supervisor/time_in_brownout_s": round(
+                float(self.supervisor_time_in_brownout_s), 6),
         }
         gp = self.goodput()
         out["serving/goodput_tokens"] = gp["goodput_tokens"]

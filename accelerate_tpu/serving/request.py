@@ -21,6 +21,11 @@ REJECT_PROMPT_TOO_LONG = "prompt_too_long"
 REJECT_EMPTY_PROMPT = "empty_prompt"
 REJECT_DEADLINE = "deadline"  # queued past its deadline, never admitted
 REJECT_DRAINING = "draining"  # engine is draining toward shutdown
+# supervisor rejections (serving/supervisor.py, docs/reliability.md
+# "Self-healing"): the restart budget is exhausted and the engine is being
+# failed loudly, or the overload brownout is shedding low-priority admissions
+REJECT_UNHEALTHY = "unhealthy"
+REJECT_OVERLOAD = "overload"
 
 
 @dataclass(frozen=True)
@@ -102,6 +107,11 @@ class Request:
     cache_prefix: bool = True
     slo: SLOSpec | None = None
     resume_tokens: list[int] = field(default_factory=list)
+    # admission priority class (higher = more important; default 0 = lowest).
+    # Only the supervisor's overload BROWNOUT reads it: at brownout level L,
+    # new admissions with priority < L are shed with REJECT_OVERLOAD
+    # (serving/supervisor.py). Scheduling order is unaffected — FIFO holds.
+    priority: int = 0
 
     @property
     def prefill_len(self) -> int:

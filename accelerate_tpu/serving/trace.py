@@ -68,10 +68,19 @@ EV_REJECT = "reject"          # terminal: never admitted (submit-time or deadlin
 EV_DISPATCH = "dispatch"      # a jitted call entered the in-flight pipeline
 EV_FETCH = "fetch"            # its results were drained to the host (or discarded)
 
+# Supervisor edges (``rid`` is None — serving/supervisor.py,
+# docs/reliability.md "Self-healing"): a hang watchdog classification, an
+# engine rebuild on the restart ladder, and an overload-brownout phase change
+# (``phase`` = "enter" | "exit", strictly alternating starting inactive).
+EV_STALL = "stall"            # heartbeat went stale past the stall timeout
+EV_RESTART = "restart"        # engine rebuilt + journal-resumed [reason, attempt]
+EV_BROWNOUT = "brownout"      # overload brownout entered/exited [phase, level]
+
 TERMINAL_KINDS = frozenset({EV_FINISH, EV_REJECT})
 REQUEST_KINDS = frozenset(
     {EV_SUBMIT, EV_QUEUED, EV_ADMIT, EV_QUARANTINE, EV_FINISH, EV_REJECT}
 )
+SUPERVISOR_KINDS = frozenset({EV_STALL, EV_RESTART, EV_BROWNOUT})
 
 
 @dataclass(frozen=True)
@@ -220,9 +229,19 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
 
       - timestamps are globally non-decreasing (one monotonic clock);
       - every request stream opens with SUBMIT and ends with *exactly one*
-        terminal event (FINISH or REJECT), with nothing after it;
+        terminal event (FINISH or REJECT), with nothing after it. A
+        ``recovered`` SUBMIT (emitted by `ServingEngine.resume` / the
+        supervisor's restart ladder over a SHARED tracer) splits the stream
+        into a new lifetime segment: each segment carries at most one
+        terminal with nothing after it, and the final segment must end
+        terminal — so a request that finished pre-restart and is then
+        re-announced by recovery replay is one clean stream, not a
+        duplicate-terminal anomaly;
       - ADMIT edges carry slot/generation, and an admitted request is
         eventually terminal;
+      - supervisor edges are well-formed: STALL carries ``elapsed_s``,
+        RESTART carries ``reason``/``attempt``, and BROWNOUT ``phase``
+        enter/exit markers strictly alternate starting from inactive;
       - DISPATCH/FETCH pairs are balanced at every pipeline depth: fetches
         drain strictly in dispatch order (the in-flight queue is FIFO), every
         fetch matches a recorded dispatch, and only a *trailing* run of
@@ -251,17 +270,51 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
             if stream[0].kind != EV_SUBMIT:
                 anomalies.append(f"rid {rid}: stream opens with {stream[0].kind}, "
                                  f"not {EV_SUBMIT}")
-            terminals = [ev for ev in stream if ev.kind in TERMINAL_KINDS]
-            if len(terminals) != 1:
-                anomalies.append(f"rid {rid}: {len(terminals)} terminal events "
-                                 f"(want exactly 1)")
-            elif stream[-1].kind not in TERMINAL_KINDS:
-                anomalies.append(f"rid {rid}: {stream[-1].kind} after terminal "
-                                 f"{terminals[0].kind}")
+            # split into lifetime segments at each recovery-replay SUBMIT:
+            # a restart re-announces the request on the shared tracer, so
+            # "exactly one terminal" holds per segment, not per stream
+            segments: list[list[TraceEvent]] = [[]]
+            for ev in stream:
+                if (ev.kind == EV_SUBMIT and ev.data.get("recovered")
+                        and segments[-1]):
+                    segments.append([])
+                segments[-1].append(ev)
+            for si, seg in enumerate(segments):
+                terminals = [ev for ev in seg if ev.kind in TERMINAL_KINDS]
+                final = si == len(segments) - 1
+                if final and len(terminals) != 1:
+                    anomalies.append(
+                        f"rid {rid}: {len(terminals)} terminal events in "
+                        f"final segment (want exactly 1)")
+                elif len(terminals) > 1:
+                    anomalies.append(
+                        f"rid {rid}: {len(terminals)} terminal events in "
+                        f"segment {si} (want at most 1)")
+                elif terminals and seg[-1].kind not in TERMINAL_KINDS:
+                    anomalies.append(f"rid {rid}: {seg[-1].kind} after "
+                                     f"terminal {terminals[0].kind}")
             for ev in stream:
                 if ev.kind == EV_ADMIT and ("slot" not in ev.data
                                             or "gen" not in ev.data):
                     anomalies.append(f"rid {rid}: admit without slot/gen")
+
+        # supervisor edges: schema + brownout enter/exit alternation
+        brownout_active = False
+        for ev in events:
+            if ev.kind == EV_STALL and "elapsed_s" not in ev.data:
+                anomalies.append("stall without elapsed_s")
+            elif ev.kind == EV_RESTART and not {"reason", "attempt"} <= set(ev.data):
+                anomalies.append("restart without reason/attempt")
+            elif ev.kind == EV_BROWNOUT:
+                phase = ev.data.get("phase")
+                if phase not in ("enter", "exit"):
+                    anomalies.append(f"brownout with phase {phase!r} "
+                                     f"(want enter|exit)")
+                elif (phase == "enter") == brownout_active:
+                    anomalies.append(f"brownout {phase} while "
+                                     f"{'active' if brownout_active else 'inactive'}")
+                else:
+                    brownout_active = phase == "enter"
 
         # dispatch/fetch pairing
         dispatch_by_seq: dict[int, TraceEvent] = {}
@@ -410,6 +463,19 @@ def to_chrome(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
         if fetch is not None:
             out.append({**base, "ph": "e", "ts": us(fetch.ts),
                         "args": dict(fetch.data)})
+
+    # --- supervisor markers (stall / restart / brownout, engine-wide) ------
+    for ev in events:
+        if ev.kind not in SUPERVISOR_KINDS:
+            continue
+        label = ev.kind
+        if ev.kind == EV_RESTART:
+            label = f"restart:{ev.data.get('reason', '?')}"
+        elif ev.kind == EV_BROWNOUT:
+            label = f"brownout:{ev.data.get('phase', '?')}"
+        out.append({"ph": "i", "pid": _PID_ENGINE, "tid": 0, "name": label,
+                    "cat": "supervisor", "ts": us(ev.ts), "s": "p",
+                    "args": dict(ev.data)})
 
     # --- slot tenancies ----------------------------------------------------
     open_tenancy: dict[int, tuple[float, int]] = {}  # slot -> (start_ts, rid)

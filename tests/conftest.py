@@ -76,6 +76,13 @@ def pytest_configure(config):
         "models/kv_cache.py BlockAllocator — docs/serving.md \"Paged KV\") — "
         "run standalone with `pytest -m paged`",
     )
+    config.addinivalue_line(
+        "markers",
+        "supervisor: self-healing serving tests (engine supervisor restart "
+        "ladder, overload brownout, journal auto-compaction — "
+        "docs/reliability.md \"Self-healing\") — run standalone with "
+        "`pytest -m supervisor`",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,440 @@
+"""Self-healing serving: the engine supervisor (`serving/supervisor.py`,
+`docs/reliability.md` "Self-healing").
+
+The load-bearing contracts: a stall, NaN storm, or device error must drive an
+AUTOMATIC journal-backed restart (no manual `resume()` call anywhere in these
+tests) with zero lost requests and bit-for-bit token parity against an
+uninterrupted run; an exhausted restart budget must fail LOUDLY with every
+in-flight request accounted as ``rejected:unhealthy``; and the overload
+brownout must shed low-priority admissions, clamp budgets, and recover
+hysteretically without oscillating at the threshold.
+"""
+
+import importlib.util
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+flax_nn = pytest.importorskip("flax.linen")
+
+pytestmark = [pytest.mark.serving, pytest.mark.supervisor]
+
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.reliability import FaultInjector, FaultSpec, RetryPolicy, inject
+from accelerate_tpu.serving import (
+    FINISH_LENGTH,
+    REJECT_OVERLOAD,
+    REJECT_UNHEALTHY,
+    EngineSupervisor,
+    EngineUnhealthyError,
+    Request,
+    RequestJournal,
+    RestartBudget,
+    SamplingParams,
+    ServingEngine,
+    ServingMetrics,
+    SupervisorConfig,
+)
+from accelerate_tpu.serving.trace import (
+    EV_BROWNOUT,
+    EV_RESTART,
+    EV_STALL,
+    EV_SUBMIT,
+    EV_FINISH,
+    TraceEvent,
+    Tracer,
+    validate,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    return module, params
+
+
+def _solo(module, params, prompt, n, temperature=0.0, top_k=None, seed=0):
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = generate(module, params, ids, max_new_tokens=n,
+                   temperature=temperature, top_k=top_k, rng=jax.random.key(seed))
+    return np.asarray(out)[0].tolist()
+
+
+def _prompts(rng_seed, lengths, vocab=256):
+    r = np.random.default_rng(rng_seed)
+    return [r.integers(0, vocab, (n,)).astype(np.int32).tolist() for n in lengths]
+
+
+def _mixed_requests(prompts, n_tokens):
+    return [
+        Request(list(p), SamplingParams(
+            max_new_tokens=n_tokens,
+            temperature=0.9 if i % 2 else 0.0,
+            top_k=5 if i % 2 else None,
+            seed=100 + i,
+        ))
+        for i, p in enumerate(prompts)
+    ]
+
+
+def _factory(module, params, concurrency=2, **fixed):
+    """Engine factory for the supervisor: same module/params objects on every
+    rebuild, so a restart hits the process shared-jit cache."""
+    def build(**kw):
+        return ServingEngine(module, params, max_concurrency=concurrency,
+                             prompt_buckets=(16, 32), max_queue=32,
+                             **fixed, **kw)
+    return build
+
+
+def _drive(sup):
+    outs = {}
+    while sup.has_work:
+        for o in sup.step():
+            outs[o.request_id] = o
+    return outs
+
+
+def _assert_parity(module, params, reqs, rids, outs):
+    """Every request finished FINISH_LENGTH with exactly the tokens an
+    uninterrupted solo `generate` emits (engine outputs are new tokens only)."""
+    for i, rid in enumerate(rids):
+        r = reqs[i]
+        assert outs[rid].finish_reason == FINISH_LENGTH, outs[rid]
+        ref = _solo(module, params, r.prompt, r.params.max_new_tokens,
+                    temperature=r.params.temperature, top_k=r.params.top_k,
+                    seed=r.params.seed)
+        assert outs[rid].tokens == ref, f"token drift on rid {rid}"
+
+
+# ------------------------------------------------------------- restart budget
+def test_restart_budget_meters_seeded_backoff():
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.05, max_delay_s=2.0,
+                         seed=0)
+    budget = RestartBudget(3, policy)
+    delays = []
+    while True:
+        d = budget.acquire()
+        if d is None:
+            break
+        delays.append(d)
+    assert len(delays) == 3 and budget.exhausted
+    # the first restart is free (the journal made it so); later ones walk
+    # the policy's seeded jittered-exponential sequence
+    assert delays[0] == 0.0
+    assert delays[1:] == list(policy.delays())[:2]
+    assert budget.acquire() is None  # stays exhausted
+    assert RestartBudget(0, policy).acquire() is None  # budget-0 fails at once
+
+
+def test_supervisor_requires_journal(model, tmp_path):
+    module, params = model
+    with pytest.raises(ValueError, match="journal"):
+        EngineSupervisor(
+            lambda **kw: ServingEngine(module, params, max_concurrency=2,
+                                       metrics=kw.get("metrics")),
+            tmp_path / "requests.journal")
+
+
+# ------------------------------------------------------------ recovery ladder
+def test_stall_detected_and_restarted_with_parity(model, tmp_path):
+    """An injected dispatch hang blows the stall timeout; the supervisor
+    classifies it (compile-excused steps don't count), rebuilds, resumes the
+    journal automatically, and every request still lands bit-for-bit."""
+    module, params = model
+    reqs = _mixed_requests(_prompts(1, [5, 9, 7, 11]), 8)
+    # several candidate dispatch indexes: if one lands on a first-dispatch
+    # compile (rightly excused), a later firing hits a pure decode dispatch
+    injector = FaultInjector(seed=0, specs=[
+        FaultSpec.step_hang(at_calls=tuple(range(3, 60, 4)), hang_s=0.4,
+                            max_faults=2)])
+    tracer = Tracer()
+    sup = EngineSupervisor(
+        _factory(module, params),
+        tmp_path / "requests.journal",
+        config=SupervisorConfig(stall_timeout_s=0.15, max_restarts=3),
+        tracer=tracer)
+    with inject(injector):
+        rids = [sup.submit(r).request_id for r in reqs]
+        outs = _drive(sup)
+    assert injector.fired, "hang fault never fired"
+    assert sup.restarts >= 1
+    assert sup.metrics.supervisor_stalls.value >= 1
+    assert sorted(outs) == sorted(rids), "lost requests across restart"
+    _assert_parity(module, params, reqs, rids, outs)
+    kinds = [e.kind for e in tracer.events()]
+    assert EV_STALL in kinds and EV_RESTART in kinds
+    valid = tracer.validate()
+    assert not valid["anomalies"], valid["anomalies"]
+    hb = sup.heartbeat()
+    assert not hb["unhealthy"] and hb["restarts"] == sup.restarts
+    sup.close()
+
+
+def test_nan_storm_escalates_to_rebuild(model, tmp_path):
+    """Isolated poisoned steps stay on the engine's soft watchdog rung; a
+    cluster of quarantines inside the window is a storm and escalates to a
+    rebuild. Different slots at different steps, so no request is poisoned
+    twice (a double offence is a legitimate FINISH_ERROR, not a storm)."""
+    module, params = model
+    reqs = _mixed_requests(_prompts(2, [6, 10, 8, 12]), 8)
+    injector = FaultInjector(seed=0, specs=[
+        FaultSpec.poison(at_steps=(3,), slots=(0,)),
+        FaultSpec.poison(at_steps=(4,), slots=(1,))])
+    sup = EngineSupervisor(
+        _factory(module, params),
+        tmp_path / "requests.journal",
+        config=SupervisorConfig(storm_quarantines=2, storm_window_steps=8,
+                                max_restarts=3))
+    with inject(injector):
+        rids = [sup.submit(r).request_id for r in reqs]
+        outs = _drive(sup)
+    assert sup.restarts >= 1
+    assert sup.metrics.supervisor_storms.value >= 1
+    assert sorted(outs) == sorted(rids)
+    _assert_parity(module, params, reqs, rids, outs)
+    sup.close()
+
+
+def test_budget_exhausted_fails_loud(model, tmp_path):
+    """Budget 0 + a device error: no flapping. Every accepted request comes
+    back ``rejected:unhealthy``, admission closes with `REJECT_UNHEALTHY`,
+    and further step() calls raise."""
+    module, params = model
+    reqs = _mixed_requests(_prompts(3, [5, 8, 6]), 8)
+    injector = FaultInjector(seed=0, specs=[
+        FaultSpec.device_error(at_calls=(4,))])
+    sup = EngineSupervisor(
+        _factory(module, params),
+        tmp_path / "requests.journal",
+        config=SupervisorConfig(max_restarts=0))
+    with inject(injector):
+        rids = [sup.submit(r).request_id for r in reqs]
+        outs = _drive(sup)
+    assert sup.unhealthy and not sup.has_work
+    assert sorted(outs) == sorted(rids), "unaccounted in-flight requests"
+    reasons = {o.finish_reason for o in outs.values()}
+    assert f"rejected:{REJECT_UNHEALTHY}" in reasons
+    assert sup.metrics.supervisor_shed.value >= 1
+    probe = sup.submit(reqs[0].prompt)
+    assert not probe.accepted and probe.reason == REJECT_UNHEALTHY
+    with pytest.raises(EngineUnhealthyError):
+        sup.step()
+    snap = sup.metrics.snapshot()
+    assert snap["supervisor/restarts"] == 0
+    assert snap["supervisor/shed_requests"] >= 1
+
+
+def test_preexisting_journal_auto_resume_matches_manual(model, tmp_path):
+    """A supervisor built over a dead process's journal auto-resumes at
+    construction — and its recovered stream is bit-for-bit identical to the
+    manual `ServingEngine.resume()` path."""
+    module, params = model
+    reqs = _mixed_requests(_prompts(4, [5, 9, 7]), 8)
+    orig = tmp_path / "orig.journal"
+    eng = ServingEngine(module, params, max_concurrency=2,
+                        prompt_buckets=(16, 32), journal=str(orig))
+    rids = [eng.submit(r).request_id for r in reqs]
+    partial = {}
+    for _ in range(4):  # abandon mid-decode: some done, some in flight
+        for o in eng.step():
+            partial[o.request_id] = o
+    eng.journal.close()
+
+    manual_path = tmp_path / "manual.journal"
+    auto_path = tmp_path / "auto.journal"
+    shutil.copy(orig, manual_path)
+    shutil.copy(orig, auto_path)
+
+    m_eng = ServingEngine(module, params, max_concurrency=2,
+                          prompt_buckets=(16, 32), journal=str(manual_path))
+    report = m_eng.resume()
+    manual = dict(report.completed)
+    while m_eng.has_work:
+        for o in m_eng.step():
+            manual[o.request_id] = o
+    m_eng.journal.close()
+
+    sup = EngineSupervisor(_factory(module, params), auto_path)
+    assert sup.last_recovery is not None, "supervisor did not auto-resume"
+    auto = _drive(sup)
+    sup.close()
+
+    assert sorted(auto) == sorted(manual) == sorted(rids)
+    for rid in rids:
+        assert auto[rid].tokens == manual[rid].tokens
+        assert auto[rid].finish_reason == manual[rid].finish_reason
+    _assert_parity(module, params, reqs, rids, auto)
+
+
+# ----------------------------------------------------------------- brownout
+def test_brownout_sheds_clamps_and_recovers_hysteretically(model, tmp_path):
+    """Synthetic headroom drives the brownout: overload raises the level and
+    sheds priority-0 admissions while clamping accepted budgets; the band
+    between calm and overloaded holds the level; sustained calm exits."""
+    module, params = model
+    head = {"est_slot_free_s": 0.0}
+    tracer = Tracer()
+    metrics = ServingMetrics()
+    sup = EngineSupervisor(
+        _factory(module, params),
+        tmp_path / "requests.journal",
+        config=SupervisorConfig(
+            brownout_ttft_s=1.0, brownout_exit_fraction=0.5,
+            brownout_exit_steps=2, brownout_max_level=1,
+            brownout_clamp_tokens=4),
+        metrics=metrics, tracer=tracer,
+        headroom_fn=lambda: dict(head))
+    sup.step()
+    assert sup.brownout_level == 0
+
+    head["est_slot_free_s"] = 5.0  # overload: enter at level 1
+    sup.step()
+    assert sup.brownout_level == 1
+    assert metrics.supervisor_brownouts.value == 1
+    assert metrics.supervisor_brownout_active == 1
+
+    prompt = _prompts(5, [6])[0]
+    low = sup.submit(Request(list(prompt), SamplingParams(max_new_tokens=8)))
+    assert not low.accepted and low.reason == REJECT_OVERLOAD
+    high = sup.submit(Request(list(prompt),
+                              SamplingParams(max_new_tokens=16), priority=1))
+    assert high.accepted
+    outs = _drive(sup)  # still overloaded throughout: level pinned at max 1
+    assert outs[high.request_id].finish_reason == FINISH_LENGTH
+    assert len(outs[high.request_id].tokens) == 4, "max_new_tokens not clamped"
+    # the clamp is real generation, not truncation: parity with a solo run
+    assert outs[high.request_id].tokens == _solo(module, params, prompt, 4)
+    assert sup.brownout_level == 1
+
+    head["est_slot_free_s"] = 0.7  # hysteresis band: neither calm nor overload
+    sup.step()
+    sup.step()
+    sup.step()
+    assert sup.brownout_level == 1, "level must hold inside the band"
+
+    head["est_slot_free_s"] = 0.2  # well inside: two calm steps walk it out
+    sup.step()
+    assert sup.brownout_level == 1
+    sup.step()
+    assert sup.brownout_level == 0
+    assert metrics.supervisor_brownout_active == 0
+    assert metrics.supervisor_time_in_brownout_s > 0.0
+
+    phases = [e.data["phase"] for e in tracer.events()
+              if e.kind == EV_BROWNOUT]
+    assert phases == ["enter", "exit"]
+    valid = tracer.validate()
+    assert not valid["anomalies"], valid["anomalies"]
+    sup.close()
+
+
+# ------------------------------------------------------- trace-stream checks
+def test_validate_supervisor_events_and_restart_segments():
+    ev = lambda ts, kind, rid=None, **data: TraceEvent(ts, kind, rid, data)
+    good = [
+        ev(0.0, EV_SUBMIT, 1),
+        ev(1.0, EV_STALL, elapsed_s=0.5, timeout_s=0.1),
+        ev(2.0, EV_RESTART, reason="stall", attempt=1),
+        # a recovered SUBMIT splits rid 1's stream into a second lifetime
+        # segment, so the single terminal afterwards is clean
+        ev(3.0, EV_SUBMIT, 1, recovered=True),
+        ev(4.0, EV_FINISH, 1, reason=FINISH_LENGTH),
+        ev(5.0, EV_BROWNOUT, phase="enter", level=1),
+        ev(6.0, EV_BROWNOUT, phase="exit", level=0),
+    ]
+    assert validate(good)["clean"], validate(good)["anomalies"]
+
+    bad_stall = validate([ev(0.0, EV_STALL)])
+    assert any("elapsed_s" in a for a in bad_stall["anomalies"])
+    bad_restart = validate([ev(0.0, EV_RESTART)])
+    assert not bad_restart["clean"]
+    double_enter = validate([ev(0.0, EV_BROWNOUT, phase="enter", level=1),
+                             ev(1.0, EV_BROWNOUT, phase="enter", level=2)])
+    assert not double_enter["clean"]
+
+
+# ------------------------------------------------------ journal auto-compaction
+def test_journal_auto_compacts_at_threshold(tmp_path):
+    p = tmp_path / "j.journal"
+    metrics = ServingMetrics()
+    j = RequestJournal(p, compact_threshold_bytes=600, metrics=metrics)
+    raw_bytes = 0
+    for rid in range(40):
+        j.log_submit(Request([1, 2, rid], SamplingParams(max_new_tokens=4),
+                             request_id=rid))
+        j.log_finish(rid, FINISH_LENGTH, [7, 8, 9, 10])
+    raw_bytes = j.bytes_written
+    j.close()
+    assert j.compactions >= 1
+    assert metrics.journal_compactions.value == j.compactions
+    assert metrics.snapshot()["serving/journal_compactions"] >= 1
+    # finished requests were dropped at each compaction boundary, so the
+    # file stays bounded far below the raw write volume
+    assert os.path.getsize(p) < raw_bytes / 4
+    scan = RequestJournal.scan(p)
+    assert scan.anomalies == 0 and scan.incomplete() == []
+
+    # fsck accepts the auto-compacted file untouched (exit-0 contract)
+    spec = importlib.util.spec_from_file_location(
+        "journal_fsck",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "journal_fsck.py"))
+    fsck_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fsck_mod)
+    report = fsck_mod.fsck(str(p))
+    assert report["clean"] and report["anomalies"] == 0
+
+
+def test_journal_compaction_rearm_avoids_thrash(tmp_path):
+    """After a compaction whose survivors still exceed the threshold (all
+    requests in flight — nothing to drop), the trigger re-arms at double the
+    surviving size instead of compacting on every append."""
+    p = tmp_path / "j.journal"
+    j = RequestJournal(p, compact_threshold_bytes=256)
+    for rid in range(12):  # submits only: compaction can never shrink these
+        j.log_submit(Request(list(range(8)), SamplingParams(), request_id=rid))
+    compactions_mid = j.compactions
+    for rid in range(12, 16):
+        j.log_submit(Request(list(range(8)), SamplingParams(), request_id=rid))
+    j.close()
+    assert j.compactions >= 1
+    # the re-arm doubled past the incompressible size: the last appends did
+    # not each pay a rewrite
+    assert j.compactions - compactions_mid < 4
+    scan = RequestJournal.scan(p)
+    assert scan.anomalies == 0 and len(scan.submits) == 16
+
+
+# ------------------------------------------------------------- observability
+def test_serve_top_renders_health_line():
+    spec = importlib.util.spec_from_file_location(
+        "serve_top",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "serve_top.py"))
+    st = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(st)
+    point = {
+        "_ts": 1700000000.0, "_step": 7,
+        "serving/mem/queue_depth": 0,
+        "serving/mem/inflight_dispatches": 2,
+        "supervisor/restarts": 2,
+        "supervisor/stalls_detected": 1,
+        "supervisor/storms_detected": 1,
+        "supervisor/shed_requests": 3,
+        "supervisor/brownout_active": 1,
+        "supervisor/time_in_brownout_s": 1.25,
+    }
+    screen = st.render(point)
+    assert "health restarts 2 (stalls 1, storms 1)" in screen
+    assert "shed 3" in screen and "brownout ACTIVE (1.2s)" in screen
+    # without supervisor gauges the health line is absent, not zero-filled
+    assert "health" not in st.render({"_ts": 1.0,
+                                      "serving/mem/queue_depth": 0})

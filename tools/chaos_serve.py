@@ -75,7 +75,19 @@ Env knobs:
                         collectives. On CPU the D*M virtual devices are
                         forced. Default: unsharded (single device)
   CHAOS_SCENARIO        "sigterm" or "sigkill" runs the kill-mid-decode
-                        crash scenario instead of the fault-injection replay
+                        crash scenario instead of the fault-injection replay;
+                        "hang" or "storm" runs the SELF-HEALING scenario
+                        (`serving/supervisor.py`): a wedged mid-decode
+                        dispatch / a NaN quarantine storm that the engine
+                        SUPERVISOR — not this harness — must detect and
+                        recover via automatic journal-backed restart, with
+                        zero lost requests and zero token drift
+  CHAOS_RESTART_BUDGET  hang/storm scenarios: the supervisor's max_restarts
+                        (default 3). 0 asserts the fail-fast contract
+                        instead: first failure goes straight to unhealthy,
+                        every in-flight request accounted rejected:unhealthy
+  CHAOS_STALL_TIMEOUT   hang scenario: supervisor stall_timeout_s (default
+                        0.15 — well under the injected 0.5 s hang)
   CHAOS_GRACE           sigterm scenario: the child handler's drain grace
                         window, seconds (default 0.05 — small on purpose, so
                         work REMAINS and the snapshot path is exercised)
@@ -356,6 +368,195 @@ def run(
     }
 
 
+def run_supervised(
+    scenario: str = "hang",
+    n_requests: int = 12,
+    concurrency: int = 2,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    max_restarts: int = 3,
+    stall_timeout_s: float = 0.15,
+    hang_s: float = 0.5,
+    verify_parity: bool = True,
+    trace_path: str | None = None,
+    workdir: str | None = None,
+) -> dict:
+    """Self-healing scenarios (``CHAOS_SCENARIO=hang|storm``): the SUPERVISOR
+    — not this harness — must recover the engine. A mid-decode hang (injected
+    dispatch sleep past the stall timeout) or a NaN storm (quarantines on two
+    slots inside the storm window) forces the restart ladder: engine rebuild
+    + automatic journal resume, with NO manual `resume()` call anywhere in
+    this function. Asserts zero lost requests, zero token drift vs solo
+    generate, and every shed request accounted as rejected. With
+    ``max_restarts=0`` (``CHAOS_RESTART_BUDGET=0``) the same run must instead
+    fail FAST: the supervisor goes unhealthy on the first failure and every
+    in-flight request comes back ``rejected:unhealthy``."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.reliability import FaultInjector, FaultSpec, inject
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        REJECT_UNHEALTHY,
+        EngineSupervisor,
+        Request,
+        ServingEngine,
+        SupervisorConfig,
+        Tracer,
+    )
+
+    if scenario not in ("hang", "storm"):
+        raise ValueError(f"unknown supervised scenario {scenario!r}")
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_supervised_")
+    journal = os.path.join(workdir, "requests.journal")
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    # saturating trace: everything arrives up front so the dispatch/step
+    # schedule — and therefore where the injected fault lands — is a pure
+    # function of the seed, not of wall-clock arrival timing
+    trace = _trace(n_requests, 1e9, seed, int(module.config.vocab_size))
+
+    if scenario == "hang":
+        # several candidate dispatch indices, capped at 2 firings: if one
+        # lands on a first-dispatch compile (which the supervisor's
+        # compile-guard rightly excuses), a later one hits a pure decode
+        # dispatch and the stall classification fires
+        specs = [FaultSpec.step_hang(at_calls=tuple(range(6, 200, 7)),
+                                     hang_s=hang_s, max_faults=2)]
+        sup_cfg = SupervisorConfig(stall_timeout_s=stall_timeout_s,
+                                   max_restarts=max_restarts)
+    else:
+        # two quarantines on DIFFERENT slots inside the window: each request
+        # is poisoned at most once (first-offence retry keeps it clean), and
+        # the storm classifier escalates the pair to a rebuild
+        specs = [FaultSpec.poison(at_steps=(3,), slots=(0,)),
+                 FaultSpec.poison(at_steps=(4,), slots=(1 % concurrency,))]
+        sup_cfg = SupervisorConfig(storm_quarantines=2, storm_window_steps=8,
+                                   max_restarts=max_restarts)
+    injector = FaultInjector(seed=seed, specs=specs)
+    tracer = Tracer() if trace_path else None
+
+    def factory(**kw):
+        # the SAME module/params objects on every rebuild: the restarted
+        # engine's jitted programs come from the process-level shared-jit
+        # cache, so recovery skips recompilation
+        return ServingEngine(
+            module, params, max_concurrency=concurrency,
+            prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+            pipeline_depth=pipeline_depth, **kw,
+        )
+
+    sup = EngineSupervisor(factory, journal, config=sup_cfg, tracer=tracer)
+    t0 = time.perf_counter()
+    submitted: list[int] = []
+    shed = 0
+    terminal: dict[int, str] = {}
+    outputs: dict[int, list[int]] = {}
+    req_by_id: dict[int, Request] = {}
+    failed_fast = False
+    with inject(injector):
+        for src in trace:
+            result = sup.submit(Request(src.prompt, src.params))
+            if result.accepted:
+                submitted.append(result.request_id)
+                req_by_id[result.request_id] = src
+            else:
+                shed += 1
+        while sup.has_work:
+            for out in sup.step():
+                terminal[out.request_id] = out.finish_reason
+                outputs[out.request_id] = out.tokens
+    if sup.unhealthy:
+        # budget exhausted: the fail-loud contract — no flapping, a raising
+        # step(), rejecting admission, and EVERY accepted request accounted
+        failed_fast = True
+        try:
+            sup.step()
+            raise AssertionError("unhealthy supervisor step() did not raise")
+        except Exception as exc:
+            assert type(exc).__name__ == "EngineUnhealthyError", exc
+        probe = sup.submit(trace[0].prompt)
+        assert not probe.accepted and probe.reason == REJECT_UNHEALTHY, probe
+        shed += 1
+        unhealthy_reason = f"rejected:{REJECT_UNHEALTHY}"
+        sheded = [r for r in terminal.values() if r == unhealthy_reason]
+        assert sheded, f"no request accounted {unhealthy_reason}: {terminal}"
+
+    lost = sorted(set(submitted) - set(terminal))
+    assert not lost, f"lost requests across supervised recovery: {lost}"
+    if not failed_fast:
+        assert sup.restarts >= 1, \
+            f"supervisor never restarted under the {scenario} scenario"
+        _assert_steady_state(sup.engine)
+
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, reason in sorted(terminal.items()):
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            src = req_by_id[rid]
+            ids = jnp.asarray(np.asarray(src.prompt, np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=src.params.max_new_tokens,
+                temperature=src.params.temperature, top_k=src.params.top_k,
+                rng=jax.random.key(src.params.seed),
+            )
+            checked += 1
+            if outputs[rid] != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, \
+            f"token drift across supervised {scenario} recovery: {drift}"
+
+    m = sup.metrics
+    reasons: dict[str, int] = {}
+    for reason in terminal.values():
+        reasons[reason] = reasons.get(reason, 0) + 1
+    trace_summary = None
+    if tracer is not None:
+        exported = tracer.export(trace_path)
+        valid = tracer.validate()
+        assert not valid["anomalies"], f"trace anomalies: {valid['anomalies']}"
+        trace_summary = {"path": exported["path"],
+                         "events": exported["events"],
+                         "dropped": exported["dropped"]}
+    sup.close()
+    return {
+        "metric": "chaos_serve_supervised_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "scenario": scenario,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "restart_budget": max_restarts,
+            "failed_fast": failed_fast,
+            "restarts": sup.restarts,
+            "stalls_detected": m.supervisor_stalls.value,
+            "storms_detected": m.supervisor_storms.value,
+            "shed_requests": shed,
+            "shed_counter": m.supervisor_shed.value,
+            "faults_fired": [(e.scope, e.call_index, e.kind)
+                             for e in injector.fired],
+            "compile_count": m.compile_count.value,
+            "terminal_reasons": reasons,
+            "parity_checked": checked,
+            "parity_drift": len(drift),
+            "trace": trace_summary,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
 def _crash_child() -> None:
     """Child half of the crash scenarios: serve the trace with a journal (and,
     under sigterm, a drain-or-snapshot preemption handler) until killed."""
@@ -610,6 +811,20 @@ def run_crash(
 def main() -> None:
     if os.environ.get("CHAOS_CRASH_CHILD"):
         _crash_child()
+        return
+    if os.environ.get("CHAOS_SCENARIO", "").lower() in ("hang", "storm"):
+        summary = run_supervised(
+            scenario=os.environ["CHAOS_SCENARIO"].lower(),
+            n_requests=_env_int("CHAOS_REQUESTS", 12),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            max_restarts=_env_int("CHAOS_RESTART_BUDGET", 3),
+            stall_timeout_s=float(os.environ.get("CHAOS_STALL_TIMEOUT", 0.15)),
+            verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+            trace_path=os.environ.get("CHAOS_TRACE") or None,
+        )
+        print(json.dumps(summary), flush=True)
         return
     if os.environ.get("CHAOS_SCENARIO"):
         summary = run_crash(

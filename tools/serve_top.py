@@ -150,6 +150,18 @@ def render(point: dict, history: list[dict] | None = None,
             f"{g('serving/headroom/token_capacity_remaining')} tokens left, "
             f"exhaustion "
             + (f"{exhaust:.1f}s" if exhaust is not None else "idle"))
+
+    restarts = g("supervisor/restarts")
+    if restarts is not None:
+        brownout = (
+            f"ACTIVE ({g('supervisor/time_in_brownout_s', 0.0):.1f}s)"
+            if g("supervisor/brownout_active", 0) else "-")
+        lines.append(
+            f"health restarts {restarts} "
+            f"(stalls {g('supervisor/stalls_detected', 0)}, "
+            f"storms {g('supervisor/storms_detected', 0)}), "
+            f"shed {g('supervisor/shed_requests', 0)}, "
+            f"brownout {brownout}")
     return "\n".join(lines)
 
 
