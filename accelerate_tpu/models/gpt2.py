@@ -66,6 +66,12 @@ class GPT2Config:
     kv_cache_paged: bool = False
     kv_num_blocks: int = 0
     kv_block_tokens: int = 16
+    # paged decode attention path: "gather" materializes pool[table] into a
+    # contiguous per-slot view and runs XLA attention over it (the parity
+    # oracle); "fused" reads K/V blocks in place through the block table with
+    # the Pallas kernel `ops.flash_attention.paged_decode_attention` — no
+    # per-layer per-step gather copy (docs/serving.md "Fused paged decode").
+    kv_paged_attention: str = "gather"
     # mesh layout for the per-slot cache (a parallel.sharding.KVCacheSharding,
     # hashable so the frozen config stays hashable): heads sharded on the
     # serving mesh's model axis, slots optionally on data. None everywhere but
@@ -121,7 +127,29 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
         v = v.reshape(b, s, cfg.n_head, head_dim)
-        if decode and cfg.kv_cache_paged:
+        if decode and cfg.kv_cache_paged and cfg.kv_paged_attention == "fused":
+            # fused paged attention: write the new token at the frontier
+            # (pool leaves only — no gathered view), then the Pallas kernel
+            # walks the block table in place. The frontier semantics are
+            # identical to the gather branch below: the query at cursor idx
+            # attends positions <= idx, i.e. a valid span of idx + 1.
+            from ..ops.flash_attention import paged_decode_attention
+            from .kv_cache import paged_decode_write
+
+            k_pool, v_pool, idx, is_init = paged_decode_write(
+                self, k, v, cfg.kv_num_blocks, cfg.kv_block_tokens,
+                block_tables, write_mask=cache_write_mask,
+                sharding=cfg.kv_cache_sharding,
+            )
+            if is_init:
+                out = paged_decode_attention(
+                    q[:, 0], k_pool, v_pool, block_tables, idx + 1
+                )[:, None]  # [b, 1, n_head, head_dim]
+            else:
+                # abstract shape-init trace: no pool yet, plain causal
+                out = attention(q, k_pool, v_pool, causal=True,
+                                implementation="xla")
+        elif decode and cfg.kv_cache_paged:
             # paged KV: the cache collection holds a shared block pool, each
             # row attends through its block table (models/kv_cache.py)
             from .kv_cache import paged_decode_update

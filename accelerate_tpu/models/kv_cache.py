@@ -248,6 +248,61 @@ def paged_decode_update(
     return k_all, v_all, idx, True
 
 
+def paged_decode_write(
+    mod: Any,  # the flax module (self) owning the "cache" collection
+    k: jax.Array,  # [b, 1, kv_heads, head_dim] new keys (one token per step)
+    v: jax.Array,
+    num_blocks: int,  # pool size; block id == num_blocks is the dropped write
+    block_tokens: int,
+    block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
+    write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
+    sharding: Any = None,  # KVCacheSharding with pool kv / index
+) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
+    """Write-only variant of `paged_decode_update` for the fused attention
+    path: identical append-at-frontier write and cursor semantics, but returns
+    the UPDATED POOL leaves — ``(k_pool, v_pool, write_index, is_init)`` with
+    the pool still ``[num_blocks, block_tokens, ...]`` — instead of gathering
+    the contiguous ``[b, span, ...]`` attended view. The Pallas kernel
+    (`ops.flash_attention.paged_decode_attention`) then reads the blocks in
+    place through the block table, so no per-layer per-step gather copy is
+    ever materialized. Frozen rows (``write_mask`` False) still redirect their
+    write to the dropped block id and keep their cursor."""
+    b, s, kv_heads, head_dim = k.shape
+    is_init = mod.has_variable("cache", "cached_key")
+    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), k.dtype)
+    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), v.dtype)
+    cache_idx = mod.variable("cache", "cache_index",
+                             lambda: jnp.zeros((b,), jnp.int32))
+    if not is_init:
+        return k, v, cache_idx.value, False
+    if s != 1:
+        raise ValueError(
+            f"paged decode writes one token per step, got a length-{s} segment "
+            "(prefill runs through the contiguous admission cache, then "
+            "scatter_rows_to_blocks)"
+        )
+    if block_tables is None:
+        raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
+    idx = cache_idx.value  # [b]
+    mask = (jnp.ones((b,), bool) if write_mask is None
+            else write_mask.astype(bool))
+    bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
+    bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
+    offs = idx % block_tokens
+    new_k = cached_k.value.at[bids, offs].set(k[:, 0], mode="drop")
+    new_v = cached_v.value.at[bids, offs].set(v[:, 0], mode="drop")
+    next_idx = idx + mask.astype(idx.dtype)
+    if sharding is not None:
+        new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
+        new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
+        next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
+    cached_k.value, cached_v.value = new_k, new_v
+    cache_idx.value = next_idx
+    return new_k, new_v, idx, True
+
+
 def _is_index_leaf(path) -> bool:
     return getattr(path[-1], "key", None) == "cache_index"
 

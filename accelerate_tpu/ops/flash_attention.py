@@ -728,3 +728,176 @@ def flash_attention(
     if d_pad:
         out = out[..., :d]
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# ------------------------------------------------- paged decode (serving)
+def _paged_decode_kernel(
+    tables, lengths, q_ref, k_ref, v_ref, o_ref, k_scr, v_scr,
+    *, block_tokens, span, scale, groups, exact,
+):
+    """One grid cell = (slot row, table block j). The block axis is LAST —
+    sequential on a TensorCore — so the K/V blocks the table names accumulate
+    in VMEM scratch across iterations and the flush at the final block runs
+    the whole single-query attention for ALL heads in one pass: fp32 QK^T,
+    scale after the dot, finfo.min frontier mask, global-max softmax, PV.
+    K/V blocks stream straight from the pool through the scalar-prefetched
+    block table — nothing is materialized in HBM.
+
+    ``exact`` (interpret mode, CPU CI) computes the flush with the head axis
+    BATCHED using the same `dot_general` dimension_numbers the gather
+    oracle's two einsums lower to. XLA's CPU emitter is invariant to the
+    batch extent but NOT to degenerate (size-1) batch dims — a per-head
+    formulation differs by ~1 ulp — so keeping heads batched makes the fused
+    path bit-identical to `dot_product_attention` over the gathered view,
+    which is the parity bar the serving tests hold (docs/serving.md). On TPU
+    the flush unrolls per head into MXU-friendly 2-D dots instead."""
+    b_ = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    length = lengths[b_]  # valid kv span for this row (frontier cursor + 1)
+    window = pl.ds(j * block_tokens, block_tokens)
+
+    @pl.when(j * block_tokens < length)
+    def _():
+        k_scr[window] = k_ref[0].astype(jnp.float32)  # [bt, kv_heads, d]
+        v_scr[window] = v_ref[0].astype(jnp.float32)
+
+    @pl.when(j * block_tokens >= length)
+    def _():
+        # past-frontier blocks (incl. clamped sentinel table entries): every
+        # position is re-masked at the flush, but the rows must be finite —
+        # a stale NaN would poison the 0-weight products
+        zeros = jnp.zeros((block_tokens,) + k_scr.shape[1:], jnp.float32)
+        k_scr[window] = zeros
+        v_scr[window] = zeros
+
+    @pl.when(j == nj - 1)
+    def _():
+        hq, d = q_ref.shape[1], q_ref.shape[2]
+        kvh = k_scr.shape[1]
+        neg = jnp.finfo(jnp.float32).min
+        if exact:
+            q4 = q_ref[...].astype(jnp.float32).reshape(1, 1, hq, d)  # [b,q,h,d]
+            k4 = k_scr[...].reshape(1, span, kvh, d)  # [b,k,h,d]
+            v4 = v_scr[...].reshape(1, span, kvh, d)
+            if groups > 1:
+                # attention() repeats kv heads before the xla path; mirror it
+                k4 = jnp.repeat(k4, groups, axis=2)
+                v4 = jnp.repeat(v4, groups, axis=2)
+            s = jax.lax.dot_general(
+                q4, k4, (((3,), (3,)), ((0, 2), (0, 2))),
+                preferred_element_type=jnp.float32,
+            )  # [1, h, 1, span] — einsum "bqhd,bkhd->bhqk"
+            s = s * scale
+            pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, span), 3)
+            s = jnp.where(pos < length, s, neg)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            w = p / jnp.sum(p, axis=-1, keepdims=True)
+            # einsum "bhqk,bkhd->bqhd" lowers with v as the LHS:
+            # dot_general(v, w, (([1],[3]), ([0,2],[0,1]))) -> [b,h,d,q]
+            o = jax.lax.dot_general(
+                v4, w, (((1,), (3,)), ((0, 2), (0, 1))),
+                preferred_element_type=jnp.float32,
+            )  # [1, h, d, 1]
+            o_ref[0] = jnp.transpose(o, (0, 3, 1, 2)).reshape(hq, d).astype(o_ref.dtype)
+        else:
+            for hh in range(hq):
+                q2 = q_ref[0, hh].astype(jnp.float32).reshape(1, d)
+                k2 = k_scr[:, hh // groups, :]  # [span, d]
+                s = jax.lax.dot_general(
+                    q2, k2, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale  # [1, span]
+                pos = jax.lax.broadcasted_iota(jnp.int32, (1, span), 1)
+                s = jnp.where(pos < length, s, neg)
+                m = jnp.max(s, axis=-1, keepdims=True)
+                p = jnp.exp(s - m)
+                w = p / jnp.sum(p, axis=-1, keepdims=True)
+                o = jax.lax.dot_general(
+                    w, v_scr[:, hh // groups, :], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [1, d]
+                o_ref[0, hh] = o.reshape(d).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [b, n_heads, head_dim] — ONE decode query per slot row
+    k_pool: jax.Array,  # [num_blocks, block_tokens, kv_heads, head_dim]
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [b, blocks_per_slot] int32 pool block ids
+    lengths: jax.Array,  # [b] int32 valid kv positions (frontier cursor + 1)
+    *,
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-query paged attention that reads K/V blocks IN PLACE from the
+    per-layer block pool (`models/kv_cache.py` `paged_decode_write`) — the
+    fused replacement for the serving engine's ``pool[table]`` gather, which
+    materializes a contiguous ``[b, span, heads, head_dim]`` copy per layer
+    per decode step.
+
+    Row ``i`` attends positions ``0..lengths[i]-1`` of its logical sequence;
+    position ``p`` lives in pool block ``block_tables[i, p // block_tokens]``
+    at offset ``p % block_tokens`` (the paged admission/decode layout).
+    Table entries at or past the pool size (the engine's released-slot
+    sentinel) are clamped to a real block id — every position they could
+    contribute is past the frontier and masked. GQA pools read kv head
+    ``h // (n_heads // kv_heads)`` directly; K/V are never repeated in HBM.
+
+    VMEM cost per slot-row cell is ``2 * span * kv_heads * head_dim`` fp32 —
+    the attended K/V span lives in scratch so the flush runs a single
+    global-max softmax, bit-identical to the XLA gather oracle under the
+    interpreter (`docs/serving.md` "Fused paged decode"); spans beyond a few
+    thousand tokens should stay on the gather path until an online-softmax
+    variant exists. Returns ``[b, n_heads, head_dim]`` in ``q.dtype``. On
+    CPU (tests/CI) runs under the Pallas interpreter."""
+    b, hq, d = q.shape
+    num_blocks, block_tokens, kvh, dk = k_pool.shape
+    if dk != d:
+        raise ValueError(f"q head_dim {d} != pool head_dim {dk}")
+    if hq % kvh:
+        raise ValueError(f"q heads ({hq}) must be a multiple of kv heads ({kvh})")
+    groups = hq // kvh
+    bps = block_tables.shape[1]
+    span = bps * block_tokens
+    if interpret is None:
+        interpret = not _on_tpu()
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    # released slots park their whole table at the sentinel id num_blocks;
+    # clamp to a real block (fully frontier-masked) so the index map never
+    # reads out of range
+    tables = jnp.minimum(block_tables.astype(jnp.int32), num_blocks - 1)
+    lengths = lengths.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, bps),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+            pl.BlockSpec(
+                (1, block_tokens, kvh, d),
+                lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_tokens, kvh, d),
+                lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((span, kvh, d), jnp.float32),
+            pltpu.VMEM((span, kvh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, block_tokens=block_tokens, span=span, scale=scale,
+        groups=groups, exact=bool(interpret),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, q, k_pool, v_pool)

@@ -154,6 +154,9 @@ class _Inflight:
     # trace pairing handle (serving/trace.py): the EV_DISPATCH sequence number
     # this entry was stamped with, echoed by its EV_FETCH; -1 when untraced
     seq: int = -1
+    # decode iterations this dispatch ran (tokens_per_sync); the fetched
+    # arrays are stacked [tokens, b] when > 1, plain [b] when 1
+    tokens: int = 1
 
 
 # engine snapshot file format tag (docs/reliability.md "Serving recovery"):
@@ -291,6 +294,8 @@ class ServingEngine:
         journal: Any = None,
         tracer: Any = None,
         telemetry: Any = None,
+        tokens_per_sync: int = 1,
+        paged_attention: str = "gather",
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -339,6 +344,29 @@ class ServingEngine:
                     f"{bt} tokens) — admission would backpressure forever"
                 )
             self._allocator = BlockAllocator(n_blocks)
+        # fused paged decode (docs/serving.md "Fused paged decode"): "fused"
+        # makes decode attention read K/V blocks in place through the block
+        # table (the Pallas kernel `ops.flash_attention.paged_decode_attention`)
+        # instead of materializing pool[table] into a contiguous view per
+        # layer per step. "gather" — the default — stays the parity oracle
+        # and the bit-for-bit PR 9 decode program.
+        self.paged_attention = str(paged_attention)
+        if self.paged_attention not in ("gather", "fused"):
+            raise ValueError(
+                f"paged_attention must be 'gather' or 'fused', "
+                f"got {paged_attention!r}"
+            )
+        if self.paged_attention == "fused":
+            if not self.paged:
+                raise ValueError(
+                    "paged_attention='fused' requires paged_kv — the fused "
+                    "kernel reads the block pool through the block tables"
+                )
+            if not hasattr(cfg, "kv_paged_attention"):
+                raise ValueError(
+                    f"{type(module).__name__} has no kv_paged_attention config "
+                    "flag; the fused paged decode path needs it (models/gpt2.py)"
+                )
         # mesh-sharded serving (docs/serving.md "Sharded serving"): ``mesh`` is
         # a Mesh, a ParallelismConfig, or a (data, model) tuple. The model axis
         # is the standard ``tensor`` axis — params shard by the training-path
@@ -401,6 +429,10 @@ class ServingEngine:
             updates["kv_cache_paged"] = True
             updates["kv_num_blocks"] = self._allocator.num_blocks
             updates["kv_block_tokens"] = self._block_tokens
+            # "gather" is the config default — adding nothing keeps the
+            # gather engine's module (and its shared-jit entry) byte-identical
+            if self.paged_attention == "fused":
+                updates["kv_paged_attention"] = "fused"
         if self.mesh is not None and hasattr(cfg, "kv_cache_sharding"):
             updates["kv_cache_sharding"] = self._slot_sharding
         if updates:
@@ -438,6 +470,15 @@ class ServingEngine:
         self.pipeline_depth = int(pipeline_depth)
         if self.pipeline_depth < 1:
             raise ValueError(f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        # multi-token decode (docs/serving.md "Fused paged decode"): run k
+        # decode iterations inside ONE jitted lax.scan between host syncs —
+        # the device-resident per-slot state and the on-device finished mask
+        # already make the host optional per token. 1 (the default) keeps the
+        # single-step program bit-for-bit what it was.
+        self.tokens_per_sync = int(tokens_per_sync)
+        if self.tokens_per_sync < 1:
+            raise ValueError(
+                f"tokens_per_sync must be >= 1, got {tokens_per_sync}")
         if int(admit_batch) < 1:
             raise ValueError(f"admit_batch must be >= 1, got {admit_batch}")
         # batch buckets: powers of two up to admit_batch — each size is one
@@ -731,10 +772,13 @@ class ServingEngine:
         )
         tr.emit(EV_DISPATCH, None, seq=entry.seq, what=what, key=key,
                 compiled=compiled, dispatch_s=round(dt, 6),
-                depth=len(self._inflight), step=self._step_count, reqs=reqs)
+                depth=len(self._inflight), step=self._step_count, reqs=reqs,
+                tokens=entry.tokens)
 
     # ------------------------------------------------------------- jitted fns
     def _build_step_fn(self):
+        if self.tokens_per_sync > 1:
+            return self._build_scan_step_fn()
         if self.paged:
             return self._build_paged_step_fn()
         module = self.module
@@ -968,6 +1012,78 @@ class ServingEngine:
                           row, row, row, row, row, row, row, row, rep,
                           self._table_sharding),
             out_shardings=(self._cache_shardings, row, row, row, row, row, row),
+        )
+
+    def _build_scan_step_fn(self):
+        """``tokens_per_sync`` = k > 1: k decode iterations inside ONE jitted
+        `lax.scan` between host syncs. The scan body is token-for-token the
+        single-step program — same apply, same rng split per iteration, same
+        finish sources — so iteration t of one scan is bit-identical to the
+        t-th of k separate dispatches. The carry is exactly the device state
+        the host round-trips today (cache/tokens/pos/remaining/finished/rng);
+        the per-iteration ``(nxt, finished, healthy)`` triple stacks into
+        ``[k, b]`` arrays the existing fetch path walks token-by-token.
+        Finished (and poisoned — health is a finish source) slots freeze
+        inside the scan, so EOS/budget/quarantine landing mid-scan just
+        carries the row unchanged for the remaining iterations."""
+        module = self.module
+        k_iters = self.tokens_per_sync
+        paged = self.paged
+
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data,
+                    finished, remaining, poison, eos_id, *tables):
+
+            def body(carry, _):
+                cache, tokens, pos, remaining, finished, rng_data = carry
+                live = ~finished
+                extra = {"block_tables": tables[0]} if paged else {}
+                logits, mutated = module.apply(
+                    {"params": params, "cache": cache}, tokens[:, None],
+                    decode=True, position_offset=pos, mutable=["cache"],
+                    cache_write_mask=live, **extra,
+                )
+                last = logits[:, -1]
+                last = jnp.where(poison[:, None],
+                                 jnp.asarray(jnp.nan, last.dtype), last)
+                ok = jnp.all(jnp.isfinite(last), axis=-1)
+                rngs = jax.random.wrap_key_data(rng_data)
+                split = jax.vmap(jax.random.split)(rngs)  # [b, 2] keys
+                new_rngs, keys = split[:, 0], split[:, 1]
+                sampled = jax.vmap(_sample_slot)(last, keys, temps, top_ks)
+                healthy = live & ok
+                nxt = jnp.where(healthy, sampled, tokens)
+                new_pos = jnp.where(healthy, pos + 1, pos)
+                new_remaining = jnp.where(healthy, remaining - 1, remaining)
+                hit_eos = (eos_id >= 0) & (nxt == eos_id)
+                new_finished = finished | (
+                    live & (~ok | hit_eos | (new_remaining <= 0)))
+                carry = (mutated["cache"], nxt, new_pos, new_remaining,
+                         new_finished, jax.random.key_data(new_rngs))
+                return carry, (nxt, new_finished, ok | finished)
+
+            carry = (cache, tokens, pos, remaining, finished, rng_data)
+            carry, (toks, fins, oks) = jax.lax.scan(
+                body, carry, None, length=k_iters)
+            cache, tokens, pos, remaining, finished, rng_data = carry
+            return (cache, tokens, pos, remaining, finished, rng_data,
+                    toks, fins, oks)
+
+        if self.mesh is None:
+            return _shared_jit(module, f"step_x{k_iters}",
+                               lambda: jax.jit(step_fn, donate_argnums=(0,)))
+        row, rep = self._row_sharding, self._rep_sharding
+        # stacked [k, b] per-iteration outputs: iteration dim replicated, the
+        # slot dim keeps its layout
+        srow = NamedSharding(self.mesh, PartitionSpec(None, *row.spec))
+        in_shardings = (self._cache_shardings, self._param_shardings,
+                        row, row, row, row, row, row, row, row, rep)
+        if paged:
+            in_shardings += (self._table_sharding,)
+        return jax.jit(
+            step_fn, donate_argnums=(0,),
+            in_shardings=in_shardings,
+            out_shardings=(self._cache_shardings, row, row, row, row, row,
+                           srow, srow, srow),
         )
 
     def _build_paged_admit_fn(self):
@@ -1334,14 +1450,27 @@ class ServingEngine:
                 # tables ride as data (not donated): decode reads through
                 # them but only admission/release rewrites them
                 step_args += (self._d_tables,)
-            (self._cache, nxt, self._d_pos, self._d_remaining, fin,
-             self._rng_data, ok) = self._dispatch(
-                self._compile_key("step"), self._step_fn, *step_args)
-            self._d_tokens, self._d_finished = nxt, fin
+            if self.tokens_per_sync == 1:
+                (self._cache, nxt, self._d_pos, self._d_remaining, fin,
+                 self._rng_data, ok) = self._dispatch(
+                    self._compile_key("step"), self._step_fn, *step_args)
+                self._d_tokens, self._d_finished = nxt, fin
+                arrays = (nxt, fin, ok)
+            else:
+                # one scan dispatch advances the device state k iterations;
+                # the stacked [k, b] outputs carry every intermediate token
+                # for the fetch path
+                (self._cache, self._d_tokens, self._d_pos, self._d_remaining,
+                 self._d_finished, self._rng_data, toks, fins, oks
+                 ) = self._dispatch(
+                    self._compile_key(f"step_x{self.tokens_per_sync}"),
+                    self._step_fn, *step_args)
+                arrays = (toks, fins, oks)
             self.metrics.dispatch_depth.observe(len(self._inflight) + 1)
             entry = _Inflight(
-                "step", (nxt, fin, ok),
+                "step", arrays,
                 tuple(range(self.max_concurrency)), tuple(self._slot_gen),
+                tokens=self.tokens_per_sync,
             )
             self._inflight.append(entry)
             self._trace_dispatch(entry, "step")
@@ -1512,7 +1641,8 @@ class ServingEngine:
             for i, entry in enumerate(self._inflight):
                 self.tracer.emit(EV_FETCH, None, seq=entry.seq,
                                  what=entry.kind, discarded=True,
-                                 depth=len(self._inflight) - i - 1)
+                                 depth=len(self._inflight) - i - 1,
+                                 tokens=entry.tokens)
         self._inflight.clear()  # every entry now predates a generation bump
         return aborted
 
@@ -1827,7 +1957,7 @@ class ServingEngine:
         if self.tracer.enabled:
             self.tracer.emit(EV_FETCH, None, seq=entry.seq, what=entry.kind,
                              blocked_s=round(blocked, 6),
-                             depth=len(self._inflight))
+                             depth=len(self._inflight), tokens=entry.tokens)
         now = time.perf_counter()
         if entry.kind == "admit":
             self._process_admit(entry, fetched, now, finished)
@@ -1868,35 +1998,70 @@ class ServingEngine:
     def _process_step(self, entry: _Inflight, fetched: tuple, now: float,
                       finished: list[RequestOutput]) -> None:
         tokens, fins, healthy = (np.asarray(a) for a in fetched)
-        poisoned_any = False
+        if tokens.ndim == 1:
+            # single-token dispatch: normalize to the stacked [k, b] layout
+            # the multi-token walk below expects (k == 1)
+            tokens, fins, healthy = tokens[None], fins[None], healthy[None]
+        k = tokens.shape[0]
+        # per-token ITL under a k-token dispatch: one fetch lands up to k
+        # tokens per slot at once, so the host-observed gap is split evenly
+        # across the tokens this entry will actually APPEND for the slot —
+        # stopping at the first unhealthy iteration (quarantine, nothing
+        # appended) or the first finish — so inter-token p50/p99 stay honest
+        # at tokens_per_sync > 1. At k == 1 the split is gap / 1: exactly the
+        # single-step sample.
+        gaps: dict[int, float] = {}
         for slot, gen in zip(entry.slots, entry.gens):
             if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
-                continue  # retired/cancelled/requeued while this was in flight
-            token = int(tokens[slot])
-            if not healthy[slot] or (self._vocab and not 0 <= token < self._vocab):
-                poisoned_any = True
-                self._quarantine(slot, now, finished)
                 continue
-            out = self._slot_out[slot]
-            out.tokens.append(token)
-            self.metrics.tokens_generated.inc()
-            gap = now - self._slot_last_token_t[slot]
-            self.metrics.inter_token_s.observe(gap)
-            if self._slot_itl[slot] is not None:
-                self._slot_itl[slot].append(gap)
-            self._slot_last_token_t[slot] = now
-            if (self.journal is not None
-                    and len(out.tokens) - self._slot_logged[slot]
-                    >= self.journal.progress_every):
-                self.journal.log_progress(
-                    out.request_id, out.tokens[self._slot_logged[slot]:],
-                    len(out.tokens),
-                )
-                self._slot_logged[slot] = len(out.tokens)
-            if fins[slot]:
-                reason = (FINISH_EOS if self.eos_token_id is not None
-                          and token == self.eos_token_id else FINISH_LENGTH)
-                self._retire(slot, reason, now, finished)
+            n = 0
+            for t in range(k):
+                token = int(tokens[t, slot])
+                if not healthy[t, slot] or (
+                        self._vocab and not 0 <= token < self._vocab):
+                    break
+                n += 1
+                if fins[t, slot]:
+                    break
+            gaps[slot] = (now - self._slot_last_token_t[slot]) / max(1, n)
+        poisoned_any = False
+        appended = 0
+        # iteration OUTER, slot inner: token t of every slot retires before
+        # token t+1 of any slot — the same order k separate single-token
+        # dispatches would produce, which is what the parity matrix pins
+        for t in range(k):
+            for slot, gen in zip(entry.slots, entry.gens):
+                if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
+                    continue  # retired/cancelled/requeued — incl. mid-scan
+                token = int(tokens[t, slot])
+                if not healthy[t, slot] or (
+                        self._vocab and not 0 <= token < self._vocab):
+                    poisoned_any = True
+                    self._quarantine(slot, now, finished)
+                    continue
+                out = self._slot_out[slot]
+                out.tokens.append(token)
+                appended += 1
+                self.metrics.tokens_generated.inc()
+                gap = gaps.get(slot, now - self._slot_last_token_t[slot])
+                self.metrics.inter_token_s.observe(gap)
+                if self._slot_itl[slot] is not None:
+                    self._slot_itl[slot].append(gap)
+                self._slot_last_token_t[slot] = now
+                if (self.journal is not None
+                        and len(out.tokens) - self._slot_logged[slot]
+                        >= self.journal.progress_every):
+                    self.journal.log_progress(
+                        out.request_id, out.tokens[self._slot_logged[slot]:],
+                        len(out.tokens),
+                    )
+                    self._slot_logged[slot] = len(out.tokens)
+                if fins[t, slot]:
+                    reason = (FINISH_EOS if self.eos_token_id is not None
+                              and token == self.eos_token_id else FINISH_LENGTH)
+                    self._retire(slot, reason, now, finished)
+        if appended:
+            self.metrics.tokens_per_dispatch.observe(appended)
         if poisoned_any:
             self.metrics.steps_poisoned.inc()
 

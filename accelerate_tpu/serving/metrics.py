@@ -101,7 +101,12 @@ class ServingMetrics:
     ``slot_occupancy`` are sampled once per engine step; ``dispatch_depth``
     (in-flight dispatches at each decode dispatch, 1 = synchronous) and
     ``admit_batch_size`` (requests per batched prefill call) are sampled at
-    each dispatch/admission.
+    each dispatch/admission. ``tokens_per_dispatch`` (tokens one decode fetch
+    appended across all slots) is sampled at each decode fetch — its mean
+    over batch size is the dispatches-per-token amortization the engine's
+    ``tokens_per_sync`` scan buys, and under multi-token dispatch each
+    ``inter_token_s`` sample is the fetch gap split evenly over that slot's
+    appended tokens so p50/p99 stay per-token honest.
     """
 
     def __init__(self):
@@ -184,6 +189,7 @@ class ServingMetrics:
         self.slot_occupancy = Histogram()
         self.dispatch_depth = Histogram()
         self.admit_batch_size = Histogram()
+        self.tokens_per_dispatch = Histogram()
         # SLO / goodput accounting (docs/observability.md): tokens from
         # requests that ATTAINED their SLO (requests without one attain
         # vacuously on a clean finish), plus per-class attainment counters
@@ -360,6 +366,7 @@ class ServingMetrics:
             ("slot_occupancy", self.slot_occupancy),
             ("dispatch_depth", self.dispatch_depth),
             ("admit_batch_size", self.admit_batch_size),
+            ("tokens_per_dispatch", self.tokens_per_dispatch),
         ):
             for stat, value in hist.summary().items():
                 out[f"serving/{name}/{stat}"] = value

@@ -328,12 +328,26 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
                     anomalies.append(f"duplicate dispatch seq {seq}")
                 else:
                     dispatch_by_seq[seq] = ev
+                # multi-token decode (tokens_per_sync): the attribute is
+                # optional (older traces), but a present value must be a
+                # positive iteration count
+                if "tokens" in ev.data and int(ev.data["tokens"]) < 1:
+                    anomalies.append(
+                        f"dispatch seq {seq} with tokens {ev.data['tokens']}")
             elif ev.kind == EV_FETCH:
                 seq = ev.data.get("seq")
                 if seq not in dispatch_by_seq:
                     anomalies.append(f"fetch seq {seq!r} without dispatch")
                 else:
                     fetched.append(seq)
+                    # one fetch drains the WHOLE k-token dispatch (still
+                    # FIFO, still seq-paired) — its tokens attribute, when
+                    # both sides carry one, must echo the dispatch's
+                    dt = dispatch_by_seq[seq].data.get("tokens")
+                    ft = ev.data.get("tokens")
+                    if dt is not None and ft is not None and dt != ft:
+                        anomalies.append(
+                            f"fetch seq {seq} tokens {ft} != dispatch {dt}")
         if fetched != sorted(fetched):
             anomalies.append("fetches drained out of dispatch (FIFO) order")
         if len(set(fetched)) != len(fetched):

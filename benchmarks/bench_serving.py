@@ -31,6 +31,17 @@ per-mode ``kv_bytes_per_token`` (peak-resident KV bytes per generated token,
 from `memory_stats()`'s exact byte accounting) and the block-pool low-water
 mark.
 
+A third machine-readable row, {"metric": "serving_decode_dispatches_per_token",
+...}, measures the fused paged-decode amortization (`docs/serving.md` "Fused
+paged decode"): the trace's head runs through paged engines across every
+(batch, tokens_per_sync, gather|fused) combination, each sub-row carrying ITL
+p50/p99 and dispatches-per-token (decode fetches / generated tokens — the
+number ``tokens_per_sync=k`` divides by ~k). value = dispatches-per-token of
+the fused engine at the largest ``tokens_per_sync``; vs_baseline = the
+single-step gather engine's dispatches-per-token over value (>1.0 = the scan
+amortizes). On CPU the fused kernel runs in Pallas interpret mode, so the
+sub-rows default to a short head of the trace (``BENCH_SERVE_FUSED_REQUESTS``).
+
 ``BENCH_SERVE_WORKLOAD=prefix`` switches to the shared-system-prompt workload
 instead: every request repeats one long system prefix with a short unique
 tail (plus a configurable fraction of cold, unique-prefix requests), and the
@@ -61,6 +72,12 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_DEPTH        pipelined run's pipeline_depth (default 2)
   BENCH_SERVE_ADMIT        admit_batch for both engine runs (default 4)
   BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system prompt)
+  BENCH_SERVE_SYNC         comma list of tokens_per_sync values for the fused
+                           decode row (default "1,4"; "" skips the row)
+  BENCH_SERVE_FUSED_BATCHES  comma list of engine batch sizes for the fused
+                           decode row (default: BENCH_SERVE_CONCURRENCY)
+  BENCH_SERVE_FUSED_REQUESTS  trace head length for the fused decode row
+                           (default 12: interpret-mode Pallas is slow on CPU)
   BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64)
   BENCH_SERVE_MISS_FRAC    prefix-mode fraction of cold-prefix requests (0.25)
   BENCH_SERVE_MESH         mesh sweep instead: comma-separated (data, model)
@@ -301,6 +318,79 @@ def _paged_capacity_row(module, params, cfg, trace, concurrency, depth,
                 / slot_row["kv_bytes_per_token"], 4),
             "slot": slot_row,
             "paged": paged_row,
+        },
+    }), flush=True)
+
+
+def _fused_decode_row(module, params, cfg, trace, concurrency, depth,
+                      admit) -> None:
+    """The fused-decode amortization rows: the SAME trace head through paged
+    engines across (batch, tokens_per_sync, gather|fused). The number under
+    test is dispatches-per-token — decode fetches over generated tokens —
+    which ``tokens_per_sync=k`` must divide by ~k (one jitted `lax.scan` runs
+    k decode iterations per host sync); ITL p50/p99 ride along so the scan's
+    latency cost is visible next to its dispatch win. Warm pass first per
+    engine, timed pass on fresh metrics (same contract as the headline row)."""
+    from accelerate_tpu.serving import PagedKVConfig, ServingMetrics
+
+    syncs = tuple(int(s) for s in
+                  os.environ.get("BENCH_SERVE_SYNC", "1,4").split(",") if s)
+    if not syncs:
+        return
+    batches = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_FUSED_BATCHES", str(concurrency)).split(",") if b)
+    head = trace[:_env_int("BENCH_SERVE_FUSED_REQUESTS", 12)]
+    block_tokens = 16
+    rows: dict[str, dict] = {}
+    for batch in batches:
+        for sync in syncs:
+            for pa in ("gather", "fused"):
+                engine = ServingEngine(
+                    module, params, max_concurrency=batch,
+                    prompt_buckets=BUCKETS, max_queue=len(head) + 1,
+                    pipeline_depth=depth, admit_batch=admit,
+                    paged_kv=PagedKVConfig(
+                        block_tokens=block_tokens,
+                        num_blocks=batch * cfg.n_positions // block_tokens),
+                    tokens_per_sync=sync, paged_attention=pa)
+                _run_engine(engine, head)  # warm: compiles land here
+                engine.metrics = ServingMetrics()
+                tps, dt, detail = _run_engine(engine, head)
+                m = engine.metrics
+                tokens = max(m.tokens_generated.value, 1)
+                row = {
+                    "row": "serving_fused_decode",
+                    "batch": batch,
+                    "tokens_per_sync": sync,
+                    "paged_attention": pa,
+                    "tokens_per_sec": round(tps, 2),
+                    "wall_s": round(dt, 3),
+                    "itl_p50_s": detail["itl_p50_s"],
+                    "itl_p99_s": detail["itl_p99_s"],
+                    "dispatches_per_token": round(
+                        m.tokens_per_dispatch.count / tokens, 4),
+                    "tokens_per_dispatch_mean": round(
+                        m.tokens_per_dispatch.mean, 3),
+                    "steps": detail["steps"],
+                }
+                rows[f"b{batch}_sync{sync}_{pa}"] = row
+                print(json.dumps(row), flush=True)
+    base = rows[f"b{batches[0]}_sync{syncs[0]}_gather"]
+    headline = rows[f"b{batches[0]}_sync{max(syncs)}_fused"]
+    print(json.dumps({
+        "metric": "serving_decode_dispatches_per_token",
+        "value": headline["dispatches_per_token"],
+        "unit": "dispatches/token",
+        "vs_baseline": round(base["dispatches_per_token"]
+                             / max(headline["dispatches_per_token"], 1e-9), 3),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "requests": len(head),
+            "admit_batch": admit,
+            "pipeline_depth": depth,
+            "itl_p50_gather_sync1_s": base["itl_p50_s"],
+            "itl_p50_fused_max_sync_s": headline["itl_p50_s"],
+            "rows": rows,
         },
     }), flush=True)
 
@@ -615,6 +705,7 @@ def main() -> None:
         },
     }), flush=True)
     _paged_capacity_row(module, params, cfg, trace, concurrency, depth, admit)
+    _fused_decode_row(module, params, cfg, trace, concurrency, depth, admit)
 
 
 if __name__ == "__main__":

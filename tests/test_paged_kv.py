@@ -23,7 +23,10 @@ pytestmark = [pytest.mark.serving, pytest.mark.paged]
 from accelerate_tpu.models.generation import generate
 from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 from accelerate_tpu.models.kv_cache import BlockAllocator
+from accelerate_tpu.reliability import FaultSpec
 from accelerate_tpu.serving import (
+    FINISH_EOS,
+    FINISH_LENGTH,
     PagedKVConfig,
     PrefixCacheConfig,
     Request,
@@ -110,25 +113,120 @@ def test_engine_validates_paged_config(model):
                       prefix_cache=PrefixCacheConfig(block_tokens=16), **kw)
 
 
+def test_engine_validates_fused_and_sync_config(model):
+    module, params = model
+    kw = dict(max_concurrency=2, prompt_buckets=(16,))
+    with pytest.raises(ValueError, match="gather.*fused|fused.*gather"):
+        ServingEngine(module, params, paged_kv=True,
+                      paged_attention="pallas", **kw)
+    with pytest.raises(ValueError, match="requires paged_kv"):
+        # the fused kernel reads the block pool through the block tables —
+        # meaningless on the contiguous slot pool
+        ServingEngine(module, params, paged_attention="fused", **kw)
+    with pytest.raises(ValueError, match="tokens_per_sync"):
+        ServingEngine(module, params, tokens_per_sync=0, **kw)
+
+
 # ------------------------------------------------------------------- parity
-@pytest.mark.parametrize("depth", [1, 2])
-@pytest.mark.parametrize("admit", [1, 4])
-def test_paged_parity_matrix(model, depth, admit):
-    """Paged mode bit-for-bit identical to slot-pool mode AND to solo
-    generate across the depth x admit matrix — the tentpole oracle."""
+@pytest.fixture(scope="module")
+def parity_refs(model):
     module, params = model
     prompts = _prompts(7, (5, 23, 40, 9))
-    refs = {i: _solo(module, params, p, 12, seed=i)
-            for i, p in enumerate(prompts)}
+    return prompts, {i: _solo(module, params, p, 12, seed=i)
+                     for i, p in enumerate(prompts)}
 
-    def serve(paged):
+
+@pytest.mark.parametrize("sync", [1, 4])
+@pytest.mark.parametrize("depth", [1, 2])
+@pytest.mark.parametrize("admit", [1, 4])
+def test_paged_parity_matrix(model, parity_refs, depth, admit, sync):
+    """Fused kernel == gather path == slot-pool mode == solo generate,
+    bit-for-bit, across the depth x admit x tokens_per_sync matrix — the
+    tentpole oracle. The fused cell runs the Pallas paged-decode kernel in
+    interpret mode on CPU; the multi-token cells run the whole decode loop
+    inside one jitted lax.scan per dispatch."""
+    module, params = model
+    prompts, refs = parity_refs
+
+    def serve(**kw):
         engine = ServingEngine(module, params, max_concurrency=4,
                                prompt_buckets=(16, 64), pipeline_depth=depth,
-                               admit_batch=admit, paged_kv=paged)
+                               admit_batch=admit, tokens_per_sync=sync, **kw)
         return {o.request_id: o.tokens for o in engine.run(_requests(prompts))}
 
-    slot, paged = serve(False), serve(True)
-    assert paged == slot == refs
+    slot = serve()
+    gather = serve(paged_kv=True)
+    fused = serve(paged_kv=True, paged_attention="fused")
+    assert fused == gather == slot == refs
+
+
+def test_eos_and_budget_landing_mid_scan(model, parity_refs):
+    """With ``tokens_per_sync=4`` a finish source can fire at any iteration
+    of the scan, not just the last: a 6-token budget lands at iteration 2 of
+    the second dispatch, and an EOS planted mid-stream lands wherever the
+    reference emits it. The on-device finished mask must freeze the row for
+    the scan's remaining iterations and the host must append exactly the
+    pre-finish prefix — no tokens past the stop, none missing."""
+    module, params = model
+    prompts, refs = parity_refs
+
+    def serve(n_new, eos=None, pa="gather"):
+        engine = ServingEngine(module, params, max_concurrency=4,
+                               prompt_buckets=(16, 64), pipeline_depth=2,
+                               admit_batch=4, paged_kv=True, tokens_per_sync=4,
+                               paged_attention=pa, eos_token_id=eos)
+        return {o.request_id: o for o in engine.run(_requests(prompts, n_new))}
+
+    for pa in ("gather", "fused"):
+        # budget mid-scan: 1 admit token + 5 decode tokens = iteration 1 of
+        # the second 4-iteration scan
+        outs = serve(6, pa=pa)
+        for rid, o in outs.items():
+            assert o.tokens == refs[rid][:6]
+            assert o.finish_reason == FINISH_LENGTH
+    # EOS mid-scan: pick a stream position whose token makes its FIRST
+    # appearance at a decode step that is not the last iteration of a scan
+    # (decode step t sits mid-scan when t % 4 != 0), and declare that token
+    # the EOS — the earlier decode steps must not emit it, and every other
+    # stream runs to budget or stops wherever it happens to emit the same id
+    rid_eos, cut = next(
+        (rid, t) for rid in sorted(refs) for t in range(2, 12)
+        if t % 4 != 0 and refs[rid][t] not in refs[rid][:t])
+    eos = refs[rid_eos][cut]
+    outs = serve(12, eos=eos)
+    assert outs[rid_eos].tokens == refs[rid_eos][:cut + 1]
+    assert outs[rid_eos].finish_reason == FINISH_EOS
+    for rid, o in outs.items():
+        if rid == rid_eos:
+            continue
+        if eos in refs[rid]:
+            stop = refs[rid].index(eos) + 1
+            assert o.tokens == refs[rid][:stop]
+        else:
+            assert o.tokens == refs[rid]
+
+
+@pytest.mark.fault
+def test_quarantine_mid_scan_replays_token_identical(model, fault_injection):
+    """A slot poisoned inside a multi-token scan freezes on device at the
+    poisoned iteration (health is a finish source), the host quarantines it
+    at that token, and the re-prefill replays the request token-identical —
+    while the co-resident healthy slot is untouched."""
+    module, params = model
+    prompts = _prompts(10, (4, 6))
+    n_new = 10
+    refs = {i: _solo(module, params, p, n_new, seed=i)
+            for i, p in enumerate(prompts)}
+    fault_injection(FaultSpec.poison(at_steps=(2,), slots=(1,)))
+    engine = ServingEngine(module, params, max_concurrency=2,
+                           prompt_buckets=(8,), paged_kv=True,
+                           tokens_per_sync=4)
+    outs = engine.run(_requests(prompts, n_new))
+    assert engine.metrics.steps_poisoned.value == 1
+    assert engine.metrics.requests_retried.value == 1
+    for o in outs:
+        assert o.finish_reason == FINISH_LENGTH
+        assert o.tokens == refs[o.request_id]
 
 
 def test_paged_frontier_partial_fill_masking(model):

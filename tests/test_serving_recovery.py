@@ -373,6 +373,47 @@ def test_resume_from_journal_paged_crash_exact(model, tmp_path):
 
 
 @pytest.mark.paged
+def test_resume_from_journal_multi_token_crash_exact(model, tmp_path):
+    """Journal kill-and-resume with ``tokens_per_sync=4``: the crash abandons
+    a dispatch that carried up to 4 un-journaled tokens per slot, and the
+    journal's PROGRESS cadence batches multi-token fetches — resume must
+    still continue every stream bit-for-bit (the rng fast-forward replays
+    whole tokens, never partial scans). Crossed with the fused kernel so the
+    restarted engine re-prefills into pool blocks the Pallas path reads."""
+    module, params = model
+
+    def build(jpath, pa):
+        return ServingEngine(
+            module, params, max_concurrency=2, prompt_buckets=(16, 32),
+            pipeline_depth=2, paged_kv=True, tokens_per_sync=4,
+            paged_attention=pa, journal=jpath)
+
+    prompts = _prompts(5, (17, 23, 9, 12))
+    reqs = _mixed_requests(prompts, 11)
+    refs = _refs(module, params, reqs)
+
+    for pa in ("gather", "fused"):
+        jpath = tmp_path / f"requests-{pa}.journal"
+        a = build(jpath, pa)
+        for r in reqs:
+            assert a.submit(Request(list(r.prompt), r.params)).accepted
+        pre = {}
+        for _ in range(2):  # mid-flight: 11-token budgets need 3 dispatches
+            for out in a.step():
+                pre[out.request_id] = out
+        del a
+
+        b = build(jpath, pa)
+        report = b.resume()
+        assert report.resumed or report.restored
+        final = dict(report.completed)
+        final.update(pre)
+        _drive(b, final)
+        assert {rid: o.tokens for rid, o in final.items()} == refs, pa
+        assert b.metrics.tokens_per_dispatch.count > 0
+
+
+@pytest.mark.paged
 def test_snapshot_restore_paged_crash_exact(model, tmp_path):
     """Snapshot/restore with paged KV and no trie: the same crash-exact bar,
     and the restored engine's pool must drain back to fully free."""

@@ -13,8 +13,8 @@ reported (``missing``) but fails the gate only under ``--strict``; a
 candidate-only metric is new and never fails.
 
 Direction is inferred from the name — ``*_s``/``*_ms`` suffixes and
-latency-ish names (ttft/itl/latency/blocked/wall/loss/compile) are
-lower-is-better, everything else higher-is-better — and overridable with
+latency-ish names (ttft/itl/latency/blocked/wall/loss/compile, plus
+dispatches_per_token) are lower-is-better, everything else higher-is-better — and overridable with
 ``--lower-better NAME``. A metric regresses when it degrades by more than
 its threshold fraction (``--threshold`` default 0.05; per-metric overrides
 via ``--metric-threshold name=frac``).
@@ -43,7 +43,7 @@ _DEFAULT_BEST = os.path.join(
 )
 
 _LOWER_BETTER_HINTS = ("ttft", "itl", "latency", "blocked", "wall", "loss",
-                       "compile")
+                       "compile", "dispatches_per_token")
 
 
 def lower_is_better(name: str, extra: tuple[str, ...] = ()) -> bool:

@@ -65,6 +65,13 @@ Env knobs:
                         fails the replay. Works with the crash scenarios too
                         (the resumed engine re-prefills into fresh blocks).
                         Default 0: the slot-pool KV path
+  CHAOS_SYNC_TOKENS     engine ``tokens_per_sync`` (default 1): k > 1 runs k
+                        decode iterations inside one jitted lax.scan per
+                        dispatch (docs/serving.md "Fused paged decode"), so
+                        quarantine, deadline expiry, and the crash scenarios
+                        all land MID-SCAN — the zero-lost / zero-drift bar is
+                        unchanged, and a crash abandons up to k un-journaled
+                        tokens per slot that resume must replay exactly
   CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
                         generate; 0 skips the reference pass
   CHAOS_MESH            "DxM" (e.g. "2x2") replays through a mesh-sharded
@@ -191,6 +198,7 @@ def run(
     mesh=None,
     trace_path: str | None = None,
     paged: bool = False,
+    sync_tokens: int = 1,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     (with ``verify_parity``) zero token drift against solo generate; return
@@ -248,6 +256,7 @@ def run(
         mesh=mesh,
         tracer=tracer,
         paged_kv=paged,
+        tokens_per_sync=sync_tokens,
     )
     blocks_free_initial = (engine.memory_stats()["block_pool/blocks_free"]
                            if paged else None)
@@ -341,6 +350,8 @@ def run(
             "pipeline_depth": pipeline_depth,
             "prefix_cache": bool(prefix_cache),
             "paged_kv": bool(paged),
+            "tokens_per_sync": sync_tokens,
+            "tokens_per_dispatch_mean": round(m.tokens_per_dispatch.mean, 3),
             "blocks_free_initial": blocks_free_initial,
             "mesh": f"{engine.mesh_shape[0]}x{engine.mesh_shape[1]}"
                     if engine.mesh is not None else None,
@@ -584,6 +595,7 @@ def _crash_child() -> None:
                       if _env_int("CHAOS_PREFIX", 1) else False),
         journal=os.environ["CHAOS_JOURNAL"],
         paged_kv=bool(_env_int("CHAOS_PAGED", 0)),
+        tokens_per_sync=_env_int("CHAOS_SYNC_TOKENS", 1),
     )
     if os.environ.get("CHAOS_SCENARIO") == "sigterm":
         install_serving_preemption_handler(
@@ -622,6 +634,7 @@ def run_crash(
     verify_parity: bool = True,
     trace_path: str | None = None,
     paged: bool = False,
+    sync_tokens: int = 1,
 ) -> dict:
     """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
     fresh engine from what survived on disk, and assert zero lost accepted
@@ -661,6 +674,7 @@ def run_crash(
         CHAOS_DEPTH=str(pipeline_depth), CHAOS_PREFIX=str(int(prefix_cache)),
         CHAOS_PREFIX_BLOCKS=str(prefix_blocks), CHAOS_GRACE=str(grace_s),
         CHAOS_PAGED=str(int(paged)),
+        CHAOS_SYNC_TOKENS=str(sync_tokens),
         JAX_PLATFORMS="cpu",
     )
     t0 = time.perf_counter()
@@ -718,6 +732,7 @@ def run_crash(
         journal=journal,
         tracer=tracer,
         paged_kv=paged,
+        tokens_per_sync=sync_tokens,
     )
     report = engine.resume(source)
     # terminal outcome per accepted rid: child finishes from the journal,
@@ -790,6 +805,7 @@ def run_crash(
             "pipeline_depth": pipeline_depth,
             "prefix_cache": bool(prefix_cache),
             "paged_kv": bool(paged),
+            "tokens_per_sync": sync_tokens,
             "finished_pre_crash": len(scan.finishes),
             "resumed_mid_stream": len(report.resumed),
             "restored_queued": len(report.restored),
@@ -839,6 +855,7 @@ def main() -> None:
             verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
             trace_path=os.environ.get("CHAOS_TRACE") or None,
             paged=bool(_env_int("CHAOS_PAGED", 0)),
+            sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
         )
         print(json.dumps(summary), flush=True)
         return
@@ -867,6 +884,7 @@ def main() -> None:
         mesh=mesh,
         trace_path=os.environ.get("CHAOS_TRACE") or None,
         paged=bool(_env_int("CHAOS_PAGED", 0)),
+        sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
     )
     print(json.dumps(summary), flush=True)
 
