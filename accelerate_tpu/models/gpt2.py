@@ -118,7 +118,8 @@ class SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
                  cache_write_mask: jax.Array | None = None,
-                 block_tables: jax.Array | None = None) -> jax.Array:
+                 block_tables: jax.Array | None = None,
+                 cache_write_len: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         b, s, e = x.shape
         head_dim = e // cfg.n_head
@@ -127,12 +128,17 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, cfg.n_head, head_dim)
         k = k.reshape(b, s, cfg.n_head, head_dim)
         v = v.reshape(b, s, cfg.n_head, head_dim)
-        if decode and cfg.kv_cache_paged and cfg.kv_paged_attention == "fused":
+        if (decode and cfg.kv_cache_paged and cfg.kv_paged_attention == "fused"
+                and s == 1 and cache_write_len is None):
             # fused paged attention: write the new token at the frontier
             # (pool leaves only — no gathered view), then the Pallas kernel
             # walks the block table in place. The frontier semantics are
             # identical to the gather branch below: the query at cursor idx
             # attends positions <= idx, i.e. a valid span of idx + 1.
+            # The kernel is single-query, so multi-token verify segments
+            # (s > 1 / cache_write_len — speculative decoding) fall through
+            # to the gather branch; s is static, so this costs nothing on the
+            # one-token fast path.
             from ..ops.flash_attention import paged_decode_attention
             from .kv_cache import paged_decode_write
 
@@ -157,7 +163,7 @@ class SelfAttention(nn.Module):
             k_all, v_all, idx, is_init = paged_decode_update(
                 self, k, v, cfg.kv_num_blocks, cfg.kv_block_tokens,
                 block_tables, write_mask=cache_write_mask,
-                sharding=cfg.kv_cache_sharding,
+                write_len=cache_write_len, sharding=cfg.kv_cache_sharding,
             )
             if is_init:
                 # same frontier mask as the per-slot path: the gathered view
@@ -183,7 +189,7 @@ class SelfAttention(nn.Module):
             k_all, v_all, idx, is_init = decode_cache_update(
                 self, k, v, max_len, kv_cache_dtype=cfg.kv_cache_dtype,
                 per_slot=cfg.kv_cache_per_slot, write_mask=cache_write_mask,
-                sharding=cfg.kv_cache_sharding,
+                write_len=cache_write_len, sharding=cfg.kv_cache_sharding,
             )
             if is_init:
                 if cfg.kv_cache_per_slot:
@@ -236,11 +242,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True, decode: bool = False,
                  cache_write_mask: jax.Array | None = None,
-                 block_tables: jax.Array | None = None) -> jax.Array:
+                 block_tables: jax.Array | None = None,
+                 cache_write_len: jax.Array | None = None) -> jax.Array:
         cfg = self.config
         # pre-norm transformer; LN statistics in fp32
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_1")(x)
-        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode, cache_write_mask, block_tables)
+        x = x + SelfAttention(cfg, name="attn")(h.astype(cfg.dtype), deterministic, decode, cache_write_mask, block_tables, cache_write_len)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_2")(x)
         x = x + MLP(cfg, name="mlp")(h.astype(cfg.dtype), deterministic)
         return x
@@ -261,6 +268,7 @@ class GPT2LMHead(nn.Module):
         return_hidden: bool = False,
         cache_write_mask: jax.Array | None = None,
         block_tables: jax.Array | None = None,
+        cache_write_len: jax.Array | None = None,
     ) -> jax.Array:
         cfg = self.config
         b, s = input_ids.shape
@@ -288,7 +296,7 @@ class GPT2LMHead(nn.Module):
             block = remat_block(Block, cfg.remat_policy, static_argnums=(2, 3))
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, deterministic, decode, cache_write_mask, block_tables), None),
+                lambda mdl, carry, _: (mdl(carry, deterministic, decode, cache_write_mask, block_tables, cache_write_len), None),
                 # fp8_meta (per-layer delayed-scaling state) stacks on the same
                 # leading layer axis as the params
                 variable_axes={"params": 0, "fp8_meta": 0},
@@ -298,7 +306,7 @@ class GPT2LMHead(nn.Module):
             )(block(cfg, name="blocks"), x, None)
         else:
             for i in range(cfg.n_layer):
-                x = block(cfg, name=f"block_{i}")(x, deterministic, decode, cache_write_mask, block_tables)
+                x = block(cfg, name=f"block_{i}")(x, deterministic, decode, cache_write_mask, block_tables, cache_write_len)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="ln_f")(x)
         if return_hidden:

@@ -29,6 +29,7 @@ def decode_cache_update(
     kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized
     per_slot: bool = False,  # [b]-vector write index (continuous batching)
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze (per_slot)
+    write_len: jax.Array | None = None,  # [b] int32: per-row segment length cap
     sharding: Any = None,  # parallel.sharding.KVCacheSharding: in-jit mesh layout
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Create/update the module's decode cache and return
@@ -70,6 +71,11 @@ def decode_cache_update(
             "write_mask requires per_slot=True (the scalar-index cache has no "
             "per-row freeze semantics)"
         )
+    if write_len is not None and not per_slot:
+        raise ValueError(
+            "write_len requires per_slot=True (per-row segment clamping is a "
+            "slot-pool decode concept)"
+        )
     quant = kv_cache_dtype is not None
     b, s, kv_heads, head_dim = k.shape
     store_dtype = jnp.int8 if quant else k.dtype
@@ -106,7 +112,24 @@ def decode_cache_update(
     if per_slot:
         # row-wise scatter: each batch row writes at its own index (vmapped
         # dynamic_update_slice keeps the update static-shape and fully jittable)
-        if write_mask is None:
+        if write_len is not None:
+            # variable-length segment scatter (speculative verify,
+            # serving/engine.py): row i writes only its first
+            # clip(write_len[i], 0, s) new entries at idx[i].. — the rest
+            # redirect past the buffer end and are dropped, so a verify
+            # segment can never overrun a row's budget/context bound the way
+            # a start-clamped dynamic_update_slice would (which silently
+            # rewrites committed history once idx + s > max_len)
+            wl = jnp.clip(write_len.astype(idx.dtype), 0, s)
+            if write_mask is not None:
+                wl = wl * write_mask.astype(wl.dtype)
+            cols = idx[:, None] + jnp.arange(s, dtype=idx.dtype)[None, :]
+            cols = jnp.where(jnp.arange(s)[None, :] < wl[:, None], cols, max_len)
+            rows = jnp.arange(b)[:, None]
+            row4 = lambda buf, new, i: buf.at[rows, cols].set(new, mode="drop")  # noqa: E731
+            row3 = row4  # broadcasted [b, s] indices cover 3-d scale planes too
+            next_idx = idx + wl
+        elif write_mask is None:
             row4 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0, 0)))
             row3 = jax.vmap(lambda buf, new, i: jax.lax.dynamic_update_slice(buf, new, (i, 0)))
             next_idx = idx + s
@@ -168,14 +191,58 @@ def decode_cache_update(
     return k_all, v_all, idx, True
 
 
+def _paged_frontier_write(
+    pool_k: jax.Array,  # [num_blocks, block_tokens, kv_heads, head_dim]
+    pool_v: jax.Array,
+    k: jax.Array,  # [b, s, kv_heads, head_dim] new keys
+    v: jax.Array,
+    idx: jax.Array,  # [b] int32 write cursors
+    mask: jax.Array,  # [b] bool: False rows freeze (dropped write)
+    write_len: jax.Array | None,  # [b] int32 per-row segment cap, or None (s==1)
+    num_blocks: int,
+    block_tokens: int,
+    block_tables: jax.Array,  # [b, blocks_per_slot] int32 pool block ids
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The append-at-frontier pool write shared by `paged_decode_update` and
+    `paged_decode_write`: returns ``(new_pool_k, new_pool_v, next_idx)``.
+
+    ``write_len=None`` is the classic one-token step (``s == 1``). With
+    ``write_len`` ([b] int32) the segment path lands row ``i``'s first
+    ``clip(write_len[i], 0, s)`` entries at token positions ``idx[i]..``
+    through the row's block table (speculative verify, `serving/engine.py`);
+    the rest redirect to block id ``num_blocks`` and are dropped, so a verify
+    segment can never write into blocks the row's reservation does not own.
+    """
+    b, s = k.shape[:2]
+    if write_len is None:
+        bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
+        bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
+        offs = idx % block_tokens
+        new_k = pool_k.at[bids, offs].set(k[:, 0], mode="drop")
+        new_v = pool_v.at[bids, offs].set(v[:, 0], mode="drop")
+        return new_k, new_v, idx + mask.astype(idx.dtype)
+    wl = jnp.clip(write_len.astype(idx.dtype), 0, s) * mask.astype(idx.dtype)
+    cols = idx[:, None] + jnp.arange(s, dtype=idx.dtype)[None, :]  # [b, s]
+    valid = jnp.arange(s)[None, :] < wl[:, None]
+    bps = block_tables.shape[1]
+    bids = block_tables[jnp.arange(b)[:, None],
+                        jnp.clip(cols // block_tokens, 0, bps - 1)]
+    bids = jnp.where(valid, bids, num_blocks)  # clamped/frozen: dropped write
+    offs = cols % block_tokens
+    new_k = pool_k.at[bids, offs].set(k, mode="drop")
+    new_v = pool_v.at[bids, offs].set(v, mode="drop")
+    return new_k, new_v, idx + wl
+
+
 def paged_decode_update(
     mod: Any,  # the flax module (self) owning the "cache" collection
-    k: jax.Array,  # [b, 1, kv_heads, head_dim] new keys (one token per step)
+    k: jax.Array,  # [b, s, kv_heads, head_dim] new keys (s == 1 unless write_len)
     v: jax.Array,
     num_blocks: int,  # pool size; block id == num_blocks is the dropped write
     block_tokens: int,
     block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
+    write_len: jax.Array | None = None,  # [b] int32: per-row segment length cap
     sharding: Any = None,  # KVCacheSharding with pool kv / index / gathered
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Paged variant of `decode_cache_update`: the cache collection holds ONE
@@ -210,23 +277,22 @@ def paged_decode_update(
                              lambda: jnp.zeros((b,), jnp.int32))
     if not is_init:
         return k, v, cache_idx.value, False
-    if s != 1:
+    if s != 1 and write_len is None:
         raise ValueError(
             f"paged decode writes one token per step, got a length-{s} segment "
             "(prefill runs through the contiguous admission cache, then "
-            "scatter_rows_to_blocks)"
+            "scatter_rows_to_blocks; multi-token verify segments must pass "
+            "write_len)"
         )
     if block_tables is None:
         raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
     idx = cache_idx.value  # [b]
     mask = (jnp.ones((b,), bool) if write_mask is None
             else write_mask.astype(bool))
-    bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
-    bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
-    offs = idx % block_tokens
-    new_k = cached_k.value.at[bids, offs].set(k[:, 0], mode="drop")
-    new_v = cached_v.value.at[bids, offs].set(v[:, 0], mode="drop")
-    next_idx = idx + mask.astype(idx.dtype)
+    new_k, new_v, next_idx = _paged_frontier_write(
+        cached_k.value, cached_v.value, k, v, idx, mask, write_len,
+        num_blocks, block_tokens, block_tables,
+    )
     if sharding is not None:
         new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
         new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
@@ -250,12 +316,13 @@ def paged_decode_update(
 
 def paged_decode_write(
     mod: Any,  # the flax module (self) owning the "cache" collection
-    k: jax.Array,  # [b, 1, kv_heads, head_dim] new keys (one token per step)
+    k: jax.Array,  # [b, s, kv_heads, head_dim] new keys (s == 1 unless write_len)
     v: jax.Array,
     num_blocks: int,  # pool size; block id == num_blocks is the dropped write
     block_tokens: int,
     block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
+    write_len: jax.Array | None = None,  # [b] int32: per-row segment length cap
     sharding: Any = None,  # KVCacheSharding with pool kv / index
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Write-only variant of `paged_decode_update` for the fused attention
@@ -277,23 +344,22 @@ def paged_decode_write(
                              lambda: jnp.zeros((b,), jnp.int32))
     if not is_init:
         return k, v, cache_idx.value, False
-    if s != 1:
+    if s != 1 and write_len is None:
         raise ValueError(
             f"paged decode writes one token per step, got a length-{s} segment "
             "(prefill runs through the contiguous admission cache, then "
-            "scatter_rows_to_blocks)"
+            "scatter_rows_to_blocks; multi-token verify segments must pass "
+            "write_len)"
         )
     if block_tables is None:
         raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
     idx = cache_idx.value  # [b]
     mask = (jnp.ones((b,), bool) if write_mask is None
             else write_mask.astype(bool))
-    bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
-    bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
-    offs = idx % block_tokens
-    new_k = cached_k.value.at[bids, offs].set(k[:, 0], mode="drop")
-    new_v = cached_v.value.at[bids, offs].set(v[:, 0], mode="drop")
-    next_idx = idx + mask.astype(idx.dtype)
+    new_k, new_v, next_idx = _paged_frontier_write(
+        cached_k.value, cached_v.value, k, v, idx, mask, write_len,
+        num_blocks, block_tokens, block_tables,
+    )
     if sharding is not None:
         new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
         new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
@@ -305,6 +371,25 @@ def paged_decode_write(
 
 def _is_index_leaf(path) -> bool:
     return getattr(path[-1], "key", None) == "cache_index"
+
+
+def rewind_frontier(cache: Any, new_index: jax.Array) -> Any:
+    """Move every ``cache_index`` cursor leaf to ``new_index`` ([b] int32)
+    without touching a single KV byte — the speculative-decoding rollback
+    (`serving/engine.py`). A rejected draft's KV entries stay behind in the
+    slot buffer / block pool, but the cursor retreat makes them dead state:
+    the next write lands on top of them and the frontier mask keeps attention
+    from ever reading past the cursor. Works unchanged for the slot-pool,
+    paged-gather, and paged-fused layouts because all three share the ``[b]``
+    cursor leaf — in paged mode this is the promised block-table rollback
+    with no pool copy."""
+
+    def stamp(path, leaf):
+        if _is_index_leaf(path):
+            return new_index.astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(stamp, cache)
 
 
 class BlockAllocator:

@@ -28,6 +28,7 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .speculation import ModelDrafter, NGramDrafter, SpeculationConfig
 from .supervisor import (
     EngineSupervisor,
     EngineUnhealthyError,
@@ -55,6 +56,9 @@ __all__ = [
     "Counter",
     "Histogram",
     "FIFOScheduler",
+    "SpeculationConfig",
+    "NGramDrafter",
+    "ModelDrafter",
     "EngineSupervisor",
     "SupervisorConfig",
     "RestartBudget",

@@ -69,6 +69,7 @@ from ..models.kv_cache import (
     BlockAllocator,
     gather_block_rows,
     make_cache,
+    rewind_frontier,
     scatter_cache_slots,
     scatter_rows_to_blocks,
     tree_bytes_by_dtype,
@@ -102,6 +103,7 @@ from .request import (
     SubmitResult,
 )
 from .scheduler import FIFOScheduler
+from .speculation import resolve_drafter
 from .telemetry import NULL_TELEMETRY
 from .trace import (
     EV_ADMIT,
@@ -296,6 +298,7 @@ class ServingEngine:
         telemetry: Any = None,
         tokens_per_sync: int = 1,
         paged_attention: str = "gather",
+        speculation: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -479,6 +482,22 @@ class ServingEngine:
         if self.tokens_per_sync < 1:
             raise ValueError(
                 f"tokens_per_sync must be >= 1, got {tokens_per_sync}")
+        # speculative decoding (docs/serving.md "Speculative decoding"): a
+        # host-side drafter proposes up to k tokens per slot and every decode
+        # dispatch becomes ONE k+1-position verify forward with on-device
+        # greedy accept/reject and per-slot frontier rollback. The drafter is
+        # a performance hint only — greedy streams stay bit-identical to
+        # speculation off (tests/test_speculation.py's parity matrix).
+        self._drafter: Any = None
+        self.draft_tokens = 0
+        if speculation is not None:
+            if self.tokens_per_sync > 1:
+                raise ValueError(
+                    "speculation requires tokens_per_sync == 1: the verify "
+                    "step is itself the multi-token dispatch, and nesting it "
+                    "in a scan would need host drafts mid-scan"
+                )
+            self._drafter, self.draft_tokens = resolve_drafter(speculation)
         if int(admit_batch) < 1:
             raise ValueError(f"admit_batch must be >= 1, got {admit_batch}")
         # batch buckets: powers of two up to admit_batch — each size is one
@@ -754,11 +773,12 @@ class ServingEngine:
         self._last_dispatch = (key, compiled, dt)
         return out
 
-    def _trace_dispatch(self, entry: _Inflight, what: str) -> None:
+    def _trace_dispatch(self, entry: _Inflight, what: str, **extra) -> None:
         """Stamp a just-enqueued `_Inflight` with a dispatch sequence number
         and emit its EV_DISPATCH span: which jitted program ran (compile or
         replay), the pipeline depth it joined at, and every (slot, rid, gen)
-        riding it — the handle `trace.validate` balances against EV_FETCH."""
+        riding it — the handle `trace.validate` balances against EV_FETCH.
+        ``extra`` attrs ride along verbatim (e.g. ``drafted`` on spec)."""
         tr = self.tracer
         if not tr.enabled:
             return
@@ -773,10 +793,12 @@ class ServingEngine:
         tr.emit(EV_DISPATCH, None, seq=entry.seq, what=what, key=key,
                 compiled=compiled, dispatch_s=round(dt, 6),
                 depth=len(self._inflight), step=self._step_count, reqs=reqs,
-                tokens=entry.tokens)
+                tokens=entry.tokens, **extra)
 
     # ------------------------------------------------------------- jitted fns
     def _build_step_fn(self):
+        if self.draft_tokens:
+            return self._build_spec_step_fn()
         if self.tokens_per_sync > 1:
             return self._build_scan_step_fn()
         if self.paged:
@@ -1084,6 +1106,128 @@ class ServingEngine:
             in_shardings=in_shardings,
             out_shardings=(self._cache_shardings, row, row, row, row, row,
                            srow, srow, srow),
+        )
+
+    def _build_spec_step_fn(self):
+        """Speculative decoding (`docs/serving.md` "Speculative decoding"):
+        one dispatch verifies the slot's last sampled token plus its k
+        host-proposed drafts in a single k+1-position forward, then accepts
+        the longest draft prefix that matches the target's own greedy argmax.
+
+        Correctness anchors, in order:
+
+        - **Write bound.** The segment writes ``min(remaining + 1, s)`` KV
+          entries per live slot (`cache_write_len`); since the admission
+          budget guarantees ``pos + remaining + 1 <= extent <= max_len``,
+          every written entry sits inside the slot's reservation. Positions
+          past the clamp produce logits that are never consumed (the accept
+          length ``n <= remaining`` never reaches them) and their writes are
+          dropped at a sentinel row/block, so committed history is untouched.
+        - **Rollback.** The model's frontier cursor lands at ``pos + s`` on
+          write; `rewind_frontier` restamps it to the ACCEPTED frontier
+          ``new_pos`` per slot — the unaccepted suffix becomes dead weight
+          past the cursor that the next dispatch simply overwrites. Frozen
+          and poisoned slots rewind to their untouched pre-step ``pos``.
+        - **Parity.** Position 0 samples through the same `_sample_slot` and
+          the same split chain as the plain step; positions 1..n-1 are the
+          target's own greedy choices at exactly the logits a sequential
+          decode would have produced (the drafts they extend matched those
+          choices). The rng chain advances one split per EMITTED token, so a
+          slot that advances n tokens lands on the key n single-token steps
+          would leave — greedy spec-on == spec-off bit-for-bit, and sampled
+          (temperature > 0) slots simply always take n = 1.
+        - **Finish/truncation.** ``n`` is clipped at the first emitted EOS
+          and at the remaining token budget, so finish semantics match the
+          sequential step token-for-token; only position n-1 can finish.
+        """
+        module = self.module
+        k_draft = self.draft_tokens
+        s = k_draft + 1
+        paged = self.paged
+
+        def step_fn(cache, params, tokens, pos, temps, top_ks, rng_data,
+                    finished, remaining, poison, eos_id, drafts, *tables):
+            b = tokens.shape[0]
+            rows = jnp.arange(b)
+            live = ~finished
+            seq = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [b, s]
+            write_len = jnp.clip(remaining + 1, 0, s) * live.astype(jnp.int32)
+            extra = {"block_tables": tables[0]} if paged else {}
+            logits, mutated = module.apply(
+                {"params": params, "cache": cache}, seq, decode=True,
+                position_offset=pos, mutable=["cache"], cache_write_mask=live,
+                cache_write_len=write_len, **extra,
+            )  # [b, s, vocab]
+            logits = jnp.where(poison[:, None, None],
+                               jnp.asarray(jnp.nan, logits.dtype), logits)
+            # watchdog health over the WHOLE segment: any non-finite row
+            # means accepted tokens may be garbage — the slot freezes with
+            # ns = 0 (frontier already rewound) and the host quarantines it
+            ok = jnp.all(jnp.isfinite(logits), axis=(1, 2))
+            greedy = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            # rng chain: precompute the key state after 1..s splits; the slot
+            # keeps state n-1, i.e. exactly one split per emitted token (the
+            # same chain the sequential step and journal fast-forward walk)
+            states = []
+            key0 = None
+            cur = jax.random.wrap_key_data(rng_data)
+            for t in range(s):
+                sp = jax.vmap(jax.random.split)(cur)
+                cur = sp[:, 0]
+                if t == 0:
+                    key0 = sp[:, 1]
+                states.append(jax.random.key_data(cur))
+            states = jnp.stack(states, axis=1)  # [b, s, *key]
+            sampled0 = jax.vmap(_sample_slot)(logits[:, 0], key0, temps, top_ks)
+            out_tokens = jnp.concatenate(
+                [sampled0[:, None], greedy[:, 1:]], axis=1)  # [b, s]
+            matches = drafts == greedy[:, :k_draft]
+            acc = jnp.cumprod(matches.astype(jnp.int32), axis=1).sum(axis=1)
+            # acceptance is an exact-match test against greedy argmax, so it
+            # is only sound for greedy slots; sampled slots advance exactly
+            # one (their position-0 token), same as the plain step
+            n_cand = jnp.where(temps > 0, 1, acc + 1)
+            hit = (eos_id >= 0) & (out_tokens == eos_id)  # [b, s]
+            first_eos = jnp.where(hit.any(axis=1), jnp.argmax(hit, axis=1), s)
+            n = jnp.minimum(n_cand, jnp.minimum(jnp.maximum(remaining, 1),
+                                                first_eos + 1))  # >= 1
+            healthy = live & ok
+            ns = jnp.where(healthy, n, 0)
+            new_tokens = jnp.where(healthy, out_tokens[rows, n - 1], tokens)
+            new_pos = jnp.where(healthy, pos + n, pos)
+            new_remaining = jnp.where(healthy, remaining - n, remaining)
+            eos_last = hit[rows, n - 1]
+            new_finished = finished | (live & ~ok) | (
+                healthy & (eos_last | (new_remaining <= 0)))
+            cond = healthy.reshape((b,) + (1,) * (rng_data.ndim - 1))
+            new_rng = jnp.where(cond, states[rows, n - 1], rng_data)
+            t_idx = jnp.arange(s)[None, :]
+            emit = healthy[:, None] & (t_idx < n[:, None])  # [b, s]
+            # budget exhaustion can only fire at t = n-1 (n <= remaining);
+            # EOS inside the accepted prefix truncated n, so it too is last
+            fins_bs = emit & (hit | (remaining[:, None] - (t_idx + 1) <= 0))
+            new_cache = rewind_frontier(mutated["cache"], new_pos)
+            return (new_cache, new_tokens, new_pos, new_remaining,
+                    new_finished, new_rng, out_tokens.T, fins_bs.T,
+                    ok | finished, ns)
+
+        if self.mesh is None:
+            return _shared_jit(module, f"spec_k{k_draft}",
+                               lambda: jax.jit(step_fn, donate_argnums=(0,)))
+        row, rep = self._row_sharding, self._rep_sharding
+        # stacked [s, b] per-position outputs: position dim replicated, slot
+        # dim keeps its layout; drafts [b, k] ride the slot layout with the
+        # position dim replicated (trailing dims of a short spec replicate)
+        srow = NamedSharding(self.mesh, PartitionSpec(None, *row.spec))
+        in_shardings = (self._cache_shardings, self._param_shardings,
+                        row, row, row, row, row, row, row, row, rep, row)
+        if paged:
+            in_shardings += (self._table_sharding,)
+        return jax.jit(
+            step_fn, donate_argnums=(0,),
+            in_shardings=in_shardings,
+            out_shardings=(self._cache_shardings, row, row, row, row, row,
+                           srow, srow, row, row),
         )
 
     def _build_paged_admit_fn(self):
@@ -1446,16 +1590,31 @@ class ServingEngine:
                 self._no_poison if poison is None else jnp.asarray(poison),
                 self._d_eos,
             )
+            if self.draft_tokens:
+                # host drafting happens at dispatch time, from the host's
+                # (possibly pipeline-lagged) view of each slot's tokens —
+                # staleness costs acceptance only, verification is exact
+                step_args += (jnp.asarray(self._propose_drafts()),)
             if self.paged:
                 # tables ride as data (not donated): decode reads through
                 # them but only admission/release rewrites them
                 step_args += (self._d_tables,)
-            if self.tokens_per_sync == 1:
+            if self.draft_tokens:
+                (self._cache, self._d_tokens, self._d_pos, self._d_remaining,
+                 self._d_finished, self._rng_data, toks, fins, oks, ns
+                 ) = self._dispatch(
+                    self._compile_key(f"spec_k{self.draft_tokens}"),
+                    self._step_fn, *step_args)
+                arrays = (toks, fins, oks, ns)
+                self.metrics.spec_forwards.inc()
+                kind, tokens_attr = "spec", self.draft_tokens + 1
+            elif self.tokens_per_sync == 1:
                 (self._cache, nxt, self._d_pos, self._d_remaining, fin,
                  self._rng_data, ok) = self._dispatch(
                     self._compile_key("step"), self._step_fn, *step_args)
                 self._d_tokens, self._d_finished = nxt, fin
                 arrays = (nxt, fin, ok)
+                kind, tokens_attr = "step", 1
             else:
                 # one scan dispatch advances the device state k iterations;
                 # the stacked [k, b] outputs carry every intermediate token
@@ -1466,14 +1625,18 @@ class ServingEngine:
                     self._compile_key(f"step_x{self.tokens_per_sync}"),
                     self._step_fn, *step_args)
                 arrays = (toks, fins, oks)
+                kind, tokens_attr = "step", self.tokens_per_sync
             self.metrics.dispatch_depth.observe(len(self._inflight) + 1)
             entry = _Inflight(
-                "step", arrays,
+                kind, arrays,
                 tuple(range(self.max_concurrency)), tuple(self._slot_gen),
-                tokens=self.tokens_per_sync,
+                tokens=tokens_attr,
             )
             self._inflight.append(entry)
-            self._trace_dispatch(entry, "step")
+            if kind == "spec":
+                self._trace_dispatch(entry, "spec", drafted=self.draft_tokens)
+            else:
+                self._trace_dispatch(entry, "step")
             if (self._probe_fn is not None
                     and self._step_count % self.collective_probe_every == 0):
                 t0 = time.perf_counter()
@@ -1955,12 +2118,17 @@ class ServingEngine:
         blocked = time.perf_counter() - blocked_t
         self.metrics.host_blocked_s.observe(blocked)
         if self.tracer.enabled:
+            extra = ({"accepted": int(np.max(fetched[3]))}
+                     if entry.kind == "spec" else {})
             self.tracer.emit(EV_FETCH, None, seq=entry.seq, what=entry.kind,
                              blocked_s=round(blocked, 6),
-                             depth=len(self._inflight), tokens=entry.tokens)
+                             depth=len(self._inflight), tokens=entry.tokens,
+                             **extra)
         now = time.perf_counter()
         if entry.kind == "admit":
             self._process_admit(entry, fetched, now, finished)
+        elif entry.kind == "spec":
+            self._process_spec(entry, fetched, now, finished)
         else:
             self._process_step(entry, fetched, now, finished)
 
@@ -2062,6 +2230,104 @@ class ServingEngine:
                     self._retire(slot, reason, now, finished)
         if appended:
             self.metrics.tokens_per_dispatch.observe(appended)
+        if poisoned_any:
+            self.metrics.steps_poisoned.inc()
+
+    def _propose_drafts(self) -> np.ndarray:
+        """One [b, k] int32 draft plane for the next verify dispatch, from
+        the drafter and the HOST view of each slot's stream (prompt + fetched
+        tokens — up to ``pipeline_depth - 1`` tokens behind the device, which
+        costs acceptance rate only: verification is an exact-match test, so a
+        stale or wrong draft can never change output). Sampled
+        (temperature > 0) slots draft nothing — they advance one token per
+        dispatch regardless — and unfilled positions stay 0, which is just a
+        draft of token 0 the verifier accepts iff it matches greedy."""
+        k = self.draft_tokens
+        drafts = np.zeros((self.max_concurrency, k), np.int32)
+        for slot in np.flatnonzero(self._active):
+            request, out = self._slot_req[slot], self._slot_out[slot]
+            if request is None or out is None:
+                continue
+            if request.params.temperature > 0:
+                continue
+            m = 0
+            for t in self._drafter.propose(request.prompt, out.tokens):
+                if m >= k:
+                    break
+                t = int(t)
+                if self._vocab and not 0 <= t < self._vocab:
+                    break  # out-of-vocab proposal: unverifiable, stop here
+                drafts[slot, m] = t
+                m += 1
+            if m:
+                self.metrics.spec_proposed.inc(m)
+        return drafts
+
+    def _process_spec(self, entry: _Inflight, fetched: tuple, now: float,
+                      finished: list[RequestOutput]) -> None:
+        """Fetch path for a speculative verify dispatch. The device reports
+        per slot how many tokens it accepted AND emitted (``ns`` — 0 for
+        frozen or poisoned rows, else 1..k+1) plus the stacked [s, b] token/
+        finish planes; the walk appends exactly ``ns[slot]`` tokens per
+        healthy slot in the same iteration-outer order `_process_step` uses,
+        so retirement order matches what ``ns[slot]`` single-token dispatches
+        would have produced. A ``!ok`` slot quarantines exactly once (its
+        generation bumps on the first offence; the device already rolled its
+        KV frontier back to the pre-step cursor)."""
+        toks, fins, oks, ns = (np.asarray(a) for a in fetched)
+        s = toks.shape[0]
+        gaps: dict[int, float] = {}
+        for slot, gen in zip(entry.slots, entry.gens):
+            if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
+                continue
+            n = int(ns[slot])
+            gaps[slot] = (now - self._slot_last_token_t[slot]) / max(1, n)
+            request = self._slot_req[slot]
+            if oks[slot] and n and request.params.temperature <= 0:
+                # greedy verify telemetry: n - 1 of the k drafts survived
+                self.metrics.spec_accepted.inc(n - 1)
+                self.metrics.spec_accept_len.observe(n - 1)
+        poisoned_any = False
+        appended = 0
+        for t in range(s):
+            for slot, gen in zip(entry.slots, entry.gens):
+                if self._slot_gen[slot] != gen or self._slot_out[slot] is None:
+                    continue  # retired/cancelled/quarantined mid-walk
+                if not oks[slot]:
+                    poisoned_any = True
+                    self._quarantine(slot, now, finished)
+                    continue
+                if t >= int(ns[slot]):
+                    continue
+                token = int(toks[t, slot])
+                if self._vocab and not 0 <= token < self._vocab:
+                    poisoned_any = True
+                    self._quarantine(slot, now, finished)
+                    continue
+                out = self._slot_out[slot]
+                out.tokens.append(token)
+                appended += 1
+                self.metrics.tokens_generated.inc()
+                gap = gaps.get(slot, now - self._slot_last_token_t[slot])
+                self.metrics.inter_token_s.observe(gap)
+                if self._slot_itl[slot] is not None:
+                    self._slot_itl[slot].append(gap)
+                self._slot_last_token_t[slot] = now
+                if (self.journal is not None
+                        and len(out.tokens) - self._slot_logged[slot]
+                        >= self.journal.progress_every):
+                    self.journal.log_progress(
+                        out.request_id, out.tokens[self._slot_logged[slot]:],
+                        len(out.tokens),
+                    )
+                    self._slot_logged[slot] = len(out.tokens)
+                if fins[t, slot]:
+                    reason = (FINISH_EOS if self.eos_token_id is not None
+                              and token == self.eos_token_id else FINISH_LENGTH)
+                    self._retire(slot, reason, now, finished)
+        if appended:
+            self.metrics.tokens_per_dispatch.observe(appended)
+            self.metrics.spec_tokens.inc(appended)
         if poisoned_any:
             self.metrics.steps_poisoned.inc()
 
@@ -2342,10 +2608,7 @@ class ServingEngine:
         for i, request in enumerate(group):
             m = matches[i] if matches is not None else None
             aliased = (m.tokens // bt) if m is not None else 0
-            extent = min(
-                len(request.prompt) + int(request.params.max_new_tokens),
-                self.max_len,
-            )
+            extent = FIFOScheduler.decode_extent(request, self.max_len)
             n_res = -(-extent // bt)  # ceil: the frontier block counts whole
             needs.append((aliased, max(0, n_res - aliased)))
         total = sum(n for _, n in needs)
@@ -2396,8 +2659,7 @@ class ServingEngine:
         capacity probe's per-request price — unpinned, so a later acquire may
         see a slightly different trie; the reservation re-checks)."""
         bt = self._block_tokens
-        extent = min(len(request.prompt) + int(request.params.max_new_tokens),
-                     self.max_len)
+        extent = FIFOScheduler.decode_extent(request, self.max_len)
         n_res = -(-extent // bt)
         if (self.prefix_cache is not None and request.cache_prefix
                 and not request.resume_tokens):

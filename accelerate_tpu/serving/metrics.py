@@ -197,6 +197,20 @@ class ServingMetrics:
         # "itl_miss", "goodput_tokens"} per class
         self.goodput_tokens = Counter()
         self.slo_classes: dict[str, dict[str, int]] = {}
+        # speculative decoding (docs/serving.md "Speculative decoding"):
+        # drafts proposed / accepted (their ratio is the drafter's accept
+        # rate), verify dispatches, tokens emitted by verify dispatches, and
+        # the per-slot accepted-draft-length distribution (0..k; mean + 1 is
+        # tokens per verify forward). The headline derived rate is
+        # ``serving/accepted_tokens_per_forward`` = spec_tokens /
+        # spec_forwards — speculation pays off when it beats 1.0, i.e. its
+        # inverse (forwards per accepted token, the bench column) drops
+        # below the 1.0 a plain autoregressive step is pinned at
+        self.spec_proposed = Counter()
+        self.spec_accepted = Counter()
+        self.spec_forwards = Counter()
+        self.spec_tokens = Counter()
+        self.spec_accept_len = Histogram()
         self._start: float | None = None
         # rate window: tokens_per_sec()/goodput() measure from the later of
         # mark_start() and the last reset_rate_window(), so an engine that
@@ -333,6 +347,13 @@ class ServingMetrics:
             "serving/replayed_tokens": self.replayed_tokens.value,
             "serving/tokens_per_sec": self.tokens_per_sec(),
             "serving/compile_count": self.compile_count.value,
+            "serving/spec_proposed": self.spec_proposed.value,
+            "serving/spec_accepted": self.spec_accepted.value,
+            "serving/spec_forwards": self.spec_forwards.value,
+            "serving/spec_tokens": self.spec_tokens.value,
+            "serving/accepted_tokens_per_forward": (
+                self.spec_tokens.value / self.spec_forwards.value
+                if self.spec_forwards.value else 0.0),
             "supervisor/restarts": self.supervisor_restarts.value,
             "supervisor/stalls_detected": self.supervisor_stalls.value,
             "supervisor/storms_detected": self.supervisor_storms.value,
@@ -367,6 +388,7 @@ class ServingMetrics:
             ("dispatch_depth", self.dispatch_depth),
             ("admit_batch_size", self.admit_batch_size),
             ("tokens_per_dispatch", self.tokens_per_dispatch),
+            ("spec_accept_len", self.spec_accept_len),
         ):
             for stat, value in hist.summary().items():
                 out[f"serving/{name}/{stat}"] = value

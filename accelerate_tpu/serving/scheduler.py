@@ -66,6 +66,18 @@ class FIFOScheduler:
             f"prompt length {prompt_len} exceeds the largest bucket {self.buckets[-1]}"
         )
 
+    @staticmethod
+    def decode_extent(request: Request, max_len: int) -> int:
+        """The furthest KV position + 1 this request can ever occupy:
+        ``min(prompt + max_new_tokens, max_len)``. This single number prices
+        paged block reservations AND bounds every decode write — the
+        admission budget is derived from it so ``pos + remaining + 1 <=
+        extent`` holds for live slots, which is what lets a speculative
+        k+1-token verify segment clamp its write length to ``remaining + 1``
+        and stay inside the reservation (see engine `_build_spec_step_fn`)."""
+        return min(len(request.prompt) + int(request.params.max_new_tokens),
+                   int(max_len))
+
     def submit(self, request: Request) -> SubmitResult:
         """Enqueue or reject-with-reason (never blocks, never raises on load).
 

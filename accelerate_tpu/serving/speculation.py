@@ -1,0 +1,175 @@
+"""Speculative-decoding drafters for the serving engine (docs/serving.md
+"Speculative decoding").
+
+A drafter is any object with a ``draft_tokens`` int attribute and a
+``propose(prompt, emitted) -> sequence[int]`` method returning up to
+``draft_tokens`` candidate next tokens for one slot. The engine feeds the
+proposals into a single k+1-position verify forward of the target model and
+accepts the longest prefix that matches the target's own greedy choices —
+so a drafter is purely a *performance hint*: a wrong (or stale, or empty)
+proposal costs acceptance rate, never correctness, and greedy output stays
+bit-identical to speculation off (the parity bar of tests/test_speculation.py).
+
+Two drafters ship:
+
+- `NGramDrafter` — prompt-lookup decoding: no second model. The slot's own
+  context (prompt + emitted tokens) is scanned for the most recent earlier
+  occurrence of its current n-gram tail, and the tokens that followed it are
+  proposed. Deterministic pure-host string matching; strongest on workloads
+  that restate their own context (summarization, code edit, retrieval).
+- `ModelDrafter` — a small model proposes via its own greedy `generate`
+  (e.g. a distilled/tiny checkpoint drafting for a large target). The draft
+  model's cache is rebuilt per proposal from the trailing context window, so
+  it needs no engine slot machinery of its own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Protocol, Sequence, runtime_checkable
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """Structural interface the engine requires of ``speculation=`` objects."""
+
+    draft_tokens: int
+
+    def propose(self, prompt: Sequence[int], emitted: Sequence[int]) -> Sequence[int]:
+        ...
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculationConfig:
+    """Engine-facing speculation settings (``ServingEngine(speculation=...)``).
+
+    ``draft_tokens`` (k) is the verify-segment depth: every decode dispatch
+    scores k+1 positions, so per-forward cost grows with k while the payoff
+    is capped by the drafter's accept length — k in 2..8 is the useful range
+    (docs/serving.md "Speculative decoding" for sizing). ``drafter`` wins
+    when set; otherwise an `NGramDrafter` is built from the n-gram knobs.
+    """
+
+    draft_tokens: int = 4
+    max_ngram: int = 3
+    min_ngram: int = 1
+    drafter: Any = None
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most recent
+    earlier occurrence of the context's current n-gram tail.
+
+    Longest tails are tried first (``max_ngram`` down to ``min_ngram``) so a
+    more specific match beats a more frequent one; within a tail length the
+    MOST RECENT earlier occurrence wins (recency tracks the local topic).
+    Returns at most ``draft_tokens`` tokens and may return fewer — including
+    none when the context has no repeated tail — which simply shrinks the
+    accepted prefix the verify step can find.
+    """
+
+    def __init__(self, draft_tokens: int = 4, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        draft_tokens, max_ngram, min_ngram = (
+            int(draft_tokens), int(max_ngram), int(min_ngram))
+        if draft_tokens < 1:
+            raise ValueError(f"draft_tokens must be >= 1, got {draft_tokens}")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got {min_ngram}..{max_ngram}")
+        self.draft_tokens = draft_tokens
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, prompt: Sequence[int], emitted: Sequence[int]) -> list[int]:
+        ctx = list(prompt) + list(emitted)
+        n_ctx = len(ctx)
+        for n in range(min(self.max_ngram, n_ctx - 1), self.min_ngram - 1, -1):
+            tail = ctx[n_ctx - n:]
+            # walk match starts right-to-left: first hit is the most recent
+            # occurrence strictly before the tail itself
+            for start in range(n_ctx - n - 1, -1, -1):
+                if ctx[start:start + n] == tail:
+                    cont = ctx[start + n:start + n + self.draft_tokens]
+                    if cont:
+                        return cont
+        return []
+
+
+class ModelDrafter:
+    """Small-model drafter: greedy `models.generation.generate` over the
+    slot's trailing context window.
+
+    The context is truncated to its largest power-of-two tail (capped by
+    ``context_tokens`` and the draft model's own position budget) so the
+    jitted generate sees a bounded set of static shapes — log2 many compiles
+    instead of one per emitted token. Truncation only costs accept rate.
+    """
+
+    def __init__(self, module: Any, params: Any, draft_tokens: int = 4,
+                 context_tokens: int = 64):
+        if int(draft_tokens) < 1:
+            raise ValueError(f"draft_tokens must be >= 1, got {draft_tokens}")
+        self.module = module
+        self.params = params
+        self.draft_tokens = int(draft_tokens)
+        n_pos = int(getattr(module.config, "n_positions", context_tokens))
+        if n_pos <= self.draft_tokens:
+            raise ValueError(
+                f"draft model has n_positions={n_pos} but must generate "
+                f"draft_tokens={self.draft_tokens} past at least one context "
+                f"token — use a draft model with n_positions > draft_tokens")
+        self.context_tokens = max(1, min(int(context_tokens),
+                                         n_pos - self.draft_tokens))
+
+    def _window(self, ctx: list[int]) -> list[int]:
+        n = min(len(ctx), self.context_tokens)
+        n = 1 << (n.bit_length() - 1)  # largest power of two <= n
+        return ctx[len(ctx) - n:]
+
+    def propose(self, prompt: Sequence[int], emitted: Sequence[int]) -> list[int]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.generation import generate
+
+        ctx = list(prompt) + list(emitted)
+        if not ctx:
+            return []
+        ctx = self._window(ctx)
+        out = generate(self.module, self.params,
+                       jnp.asarray([ctx], jnp.int32),
+                       max_new_tokens=self.draft_tokens, temperature=0.0)
+        return [int(t) for t in np.asarray(out)[0]]
+
+
+def resolve_drafter(speculation: Any) -> tuple[Any, int]:
+    """Normalize the engine's ``speculation=`` argument to ``(drafter, k)``.
+
+    Accepts an int k (prompt-lookup drafter with that depth), a
+    `SpeculationConfig`, or any `Drafter` instance directly.
+    """
+    if isinstance(speculation, bool):
+        raise ValueError(
+            "speculation takes a draft depth (int k), a SpeculationConfig, or "
+            "a drafter — a bare bool does not say how deep to draft")
+    if isinstance(speculation, int):
+        drafter: Any = NGramDrafter(draft_tokens=speculation)
+    elif isinstance(speculation, SpeculationConfig):
+        drafter = speculation.drafter
+        if drafter is None:
+            drafter = NGramDrafter(
+                draft_tokens=speculation.draft_tokens,
+                max_ngram=speculation.max_ngram,
+                min_ngram=speculation.min_ngram,
+            )
+    elif hasattr(speculation, "propose") and hasattr(speculation, "draft_tokens"):
+        drafter = speculation
+    else:
+        raise ValueError(
+            f"speculation must be an int, SpeculationConfig, or Drafter "
+            f"(got {type(speculation).__name__})")
+    k = int(drafter.draft_tokens)
+    if k < 1:
+        raise ValueError(f"draft_tokens must be >= 1, got {k}")
+    return drafter, k

@@ -348,6 +348,16 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
                     if dt is not None and ft is not None and dt != ft:
                         anomalies.append(
                             f"fetch seq {seq} tokens {ft} != dispatch {dt}")
+                    # speculative verify pair: the dispatch proposed k drafts
+                    # ("drafted"), so no slot can have accepted more than
+                    # k + 1 tokens (k survivors + the always-emitted base)
+                    drafted = dispatch_by_seq[seq].data.get("drafted")
+                    accepted = ev.data.get("accepted")
+                    if (drafted is not None and accepted is not None
+                            and int(accepted) > int(drafted) + 1):
+                        anomalies.append(
+                            f"fetch seq {seq} accepted {accepted} > "
+                            f"drafted {drafted} + 1")
         if fetched != sorted(fetched):
             anomalies.append("fetches drained out of dispatch (FIFO) order")
         if len(set(fetched)) != len(fetched):

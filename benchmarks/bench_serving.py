@@ -42,6 +42,19 @@ single-step gather engine's dispatches-per-token over value (>1.0 = the scan
 amortizes). On CPU the fused kernel runs in Pallas interpret mode, so the
 sub-rows default to a short head of the trace (``BENCH_SERVE_FUSED_REQUESTS``).
 
+A fourth machine-readable row, {"metric": "serving_spec_forwards_per_accepted",
+...}, measures speculative decoding (`docs/serving.md` "Speculative
+decoding"): a prompt-lookup-friendly trace (motif-repeated prompts, greedy)
+runs through paged engines across every (batch, draft_k, drafter)
+combination, each sub-row carrying accept rate, mean accept length,
+per-sequence forwards-per-accepted-token, and ITL p50/p99. value =
+forwards-per-accepted-token of the deepest-draft engine (verify forwards one
+request costs per emitted token; the PR-12 acceptance bar is < 1.0 —
+strictly cheaper than plain decode's exact one-forward-per-token floor);
+vs_baseline = the spec-off floor (1.0) over value (>1.0 = drafting
+amortizes). `tools/bench_gate.py` treats the metric as lower-is-better via
+its ``forwards_per_accepted`` name hint.
+
 ``BENCH_SERVE_WORKLOAD=prefix`` switches to the shared-system-prompt workload
 instead: every request repeats one long system prefix with a short unique
 tail (plus a configurable fraction of cold, unique-prefix requests), and the
@@ -78,6 +91,15 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
                            decode row (default: BENCH_SERVE_CONCURRENCY)
   BENCH_SERVE_FUSED_REQUESTS  trace head length for the fused decode row
                            (default 12: interpret-mode Pallas is slow on CPU)
+  BENCH_SERVE_SPEC         comma list of speculation draft depths k for the
+                           speculation row; 0 = spec-off baseline geometry
+                           (default "0,4"; "" skips the row)
+  BENCH_SERVE_SPEC_BATCHES comma list of engine batch sizes for the
+                           speculation row (default: BENCH_SERVE_CONCURRENCY)
+  BENCH_SERVE_SPEC_DRAFTERS  comma list of drafters for the speculation row:
+                           "ngram" (prompt lookup, default) and/or "model"
+                           (tiny same-vocab draft model)
+  BENCH_SERVE_SPEC_REQUESTS  speculation-row trace length (default 12)
   BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64)
   BENCH_SERVE_MISS_FRAC    prefix-mode fraction of cold-prefix requests (0.25)
   BENCH_SERVE_MESH         mesh sweep instead: comma-separated (data, model)
@@ -395,6 +417,163 @@ def _fused_decode_row(module, params, cfg, trace, concurrency, depth,
     }), flush=True)
 
 
+def _spec_trace(n: int, rate: float, seed: int, vocab: int) -> list[Request]:
+    """Prompt-lookup-friendly workload: each prompt is a short random motif
+    repeated a few times, so the n-gram drafter's suffix match keeps finding
+    the continuation inside the request's own history — the self-similar
+    regime (templated replies, code edits, summarization) speculation is for.
+    Greedy throughout: sampled slots draft nothing by design, so a sampled
+    trace would measure the drafter's idle path, not its win."""
+    r = np.random.default_rng(seed)
+    t, reqs = 0.0, []
+    for _ in range(n):
+        t += float(r.exponential(1.0 / rate))
+        motif = r.integers(0, vocab, (int(r.integers(3, 7)),)).astype(np.int32).tolist()
+        prompt = (motif * int(r.integers(3, 6)))[:BUCKETS[-1]]
+        reqs.append(Request(
+            prompt=prompt,
+            params=SamplingParams(max_new_tokens=int(r.integers(16, 33))),
+            arrival_time=t,
+        ))
+    return reqs
+
+
+def _speculation_row(module, params, cfg, concurrency, depth, admit) -> None:
+    """The speculative-decoding rows: the SAME prompt-lookup-friendly trace
+    through paged engines (block-table rollback is the production path —
+    docs/serving.md "Speculative decoding") across every (batch, draft_k,
+    drafter) combination. The number under test is forwards-per-accepted-token
+    PER SLOT SEQUENCE — how many verify forwards one request costs per emitted
+    token — which drafting must push BELOW the 1.0 one-forward-one-token floor
+    of plain decode. The floor is exact by construction (spec off, a slot
+    emits exactly one token per dispatch it participates in), and the spec
+    rows measure it as emitted tokens over per-slot verify participations
+    (`spec_accept_len`'s observation count — every healthy greedy slot in a
+    spec dispatch observes exactly once, and this trace is all-greedy).
+    Batch-level ``accepted_tokens_per_dispatch`` (the snapshot's
+    ``serving/accepted_tokens_per_forward`` view, where one dispatch batches
+    all slots) rides along, with accept rate and ITL p50/p99 (a rejected deep
+    draft shows up as latency, never as drift: verification is exact). Warm
+    pass first per engine, timed pass on fresh metrics (same contract as the
+    headline row)."""
+    from accelerate_tpu.serving import (
+        ModelDrafter,
+        PagedKVConfig,
+        ServingMetrics,
+        SpeculationConfig,
+    )
+
+    ks = tuple(int(s) for s in
+               os.environ.get("BENCH_SERVE_SPEC", "0,4").split(",") if s)
+    if not ks:
+        return
+    batches = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_SPEC_BATCHES", str(concurrency)).split(",") if b)
+    drafters = tuple(d.strip() for d in os.environ.get(
+        "BENCH_SERVE_SPEC_DRAFTERS", "ngram").split(",") if d.strip())
+    trace = _spec_trace(_env_int("BENCH_SERVE_SPEC_REQUESTS", 12),
+                        float(os.environ.get("BENCH_SERVE_RATE", 200.0)),
+                        _env_int("BENCH_SERVE_SEED", 0), cfg.vocab_size)
+    block_tokens = 16
+    draft_pair = None
+
+    def speculation_arg(k: int, name: str):
+        if name == "model":
+            # tiny same-vocab draft model: the point is the mechanism's cost
+            # accounting (two models, one verify), not a trained drafter's
+            # accept rate — untrained draft/target pairs agree rarely
+            nonlocal draft_pair
+            if draft_pair is None:
+                dcfg = GPT2Config(
+                    vocab_size=cfg.vocab_size, n_positions=cfg.n_positions,
+                    n_embd=128, n_layer=2, n_head=4,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+                dmod = GPT2LMHead(dcfg)
+                draft_pair = (dmod, dmod.init_params(jax.random.key(1)))
+            return SpeculationConfig(draft_tokens=k, drafter=ModelDrafter(
+                draft_pair[0], draft_pair[1], draft_tokens=k))
+        return k
+
+    rows: dict[str, dict] = {}
+    for batch in batches:
+        for k in ks:
+            for name in (drafters if k else ("off",)):
+                engine = ServingEngine(
+                    module, params, max_concurrency=batch,
+                    prompt_buckets=BUCKETS, max_queue=len(trace) + 1,
+                    pipeline_depth=depth, admit_batch=admit,
+                    paged_kv=PagedKVConfig(
+                        block_tokens=block_tokens,
+                        num_blocks=batch * cfg.n_positions // block_tokens),
+                    speculation=speculation_arg(k, name) if k else None)
+                _run_engine(engine, trace)  # warm: compiles land here
+                engine.metrics = ServingMetrics()
+                tps, dt, detail = _run_engine(engine, trace)
+                m = engine.metrics
+                if k:
+                    # per-slot: one verify participation per healthy greedy
+                    # slot per dispatch (== one spec_accept_len observation)
+                    slot_forwards = m.spec_accept_len.count
+                    fpt = slot_forwards / max(m.spec_tokens.value, 1)
+                    per_dispatch = m.spec_tokens.value / max(
+                        m.spec_forwards.value, 1)
+                else:
+                    # spec off with tokens_per_sync=1: a slot emits exactly
+                    # one token per dispatch it joins — the floor is exact
+                    fpt = 1.0
+                    per_dispatch = m.tokens_per_dispatch.mean
+                row = {
+                    "row": "serving_speculation",
+                    "batch": batch,
+                    "draft_k": k,
+                    "drafter": name,
+                    "tokens_per_sec": round(tps, 2),
+                    "wall_s": round(dt, 3),
+                    "itl_p50_s": detail["itl_p50_s"],
+                    "itl_p99_s": detail["itl_p99_s"],
+                    "accept_rate": round(
+                        m.spec_accepted.value / max(m.spec_proposed.value, 1), 4)
+                        if k else None,
+                    "spec_accept_len_mean": round(m.spec_accept_len.mean, 3)
+                        if k else None,
+                    "forwards_per_accepted_token": round(fpt, 4),
+                    "accepted_tokens_per_dispatch": round(per_dispatch, 3),
+                    "steps": detail["steps"],
+                }
+                rows[f"b{batch}_k{k}_{name}"] = row
+                print(json.dumps(row), flush=True)
+
+    spec_ks = [k for k in ks if k]
+    if not spec_ks:
+        return
+    headline = rows[f"b{batches[0]}_k{max(spec_ks)}_{drafters[0]}"]
+    base = rows.get(f"b{batches[0]}_k0_off")
+    print(json.dumps({
+        "metric": "serving_spec_forwards_per_accepted",
+        "value": headline["forwards_per_accepted_token"],
+        "unit": "forwards/token",
+        # >1.0 = speculation amortizes: the spec-off engine spends this many
+        # times more verify forwards per emitted token than the drafted one
+        "vs_baseline": round(
+            base["forwards_per_accepted_token"]
+            / max(headline["forwards_per_accepted_token"], 1e-9), 3)
+            if base else None,
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "requests": len(trace),
+            "admit_batch": admit,
+            "pipeline_depth": depth,
+            "accept_rate": headline["accept_rate"],
+            "spec_accept_len_mean": headline["spec_accept_len_mean"],
+            "accepted_tokens_per_dispatch":
+                headline["accepted_tokens_per_dispatch"],
+            "itl_p50_spec_s": headline["itl_p50_s"],
+            "itl_p50_off_s": base["itl_p50_s"] if base else None,
+            "rows": rows,
+        },
+    }), flush=True)
+
+
 def _prefix_trace(n: int, rate: float, seed: int, vocab: int, prefix_len: int,
                   miss_frac: float) -> list[Request]:
     """Shared-system-prompt workload: every hot request is one common
@@ -706,6 +885,7 @@ def main() -> None:
     }), flush=True)
     _paged_capacity_row(module, params, cfg, trace, concurrency, depth, admit)
     _fused_decode_row(module, params, cfg, trace, concurrency, depth, admit)
+    _speculation_row(module, params, cfg, concurrency, depth, admit)
 
 
 if __name__ == "__main__":

@@ -83,6 +83,12 @@ def pytest_configure(config):
         "docs/reliability.md \"Self-healing\") — run standalone with "
         "`pytest -m supervisor`",
     )
+    config.addinivalue_line(
+        "markers",
+        "speculation: speculative-decoding tests (drafters, batched verify, "
+        "block-table rollback — docs/serving.md \"Speculative decoding\") — "
+        "run standalone with `pytest -m speculation`",
+    )
 
 
 @pytest.fixture

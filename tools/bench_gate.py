@@ -14,7 +14,8 @@ candidate-only metric is new and never fails.
 
 Direction is inferred from the name — ``*_s``/``*_ms`` suffixes and
 latency-ish names (ttft/itl/latency/blocked/wall/loss/compile, plus
-dispatches_per_token) are lower-is-better, everything else higher-is-better — and overridable with
+dispatches_per_token and forwards_per_accepted) are lower-is-better,
+everything else higher-is-better — and overridable with
 ``--lower-better NAME``. A metric regresses when it degrades by more than
 its threshold fraction (``--threshold`` default 0.05; per-metric overrides
 via ``--metric-threshold name=frac``).
@@ -43,7 +44,8 @@ _DEFAULT_BEST = os.path.join(
 )
 
 _LOWER_BETTER_HINTS = ("ttft", "itl", "latency", "blocked", "wall", "loss",
-                       "compile", "dispatches_per_token")
+                       "compile", "dispatches_per_token",
+                       "forwards_per_accepted")
 
 
 def lower_is_better(name: str, extra: tuple[str, ...] = ()) -> bool:

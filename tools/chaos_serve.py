@@ -72,6 +72,17 @@ Env knobs:
                         all land MID-SCAN — the zero-lost / zero-drift bar is
                         unchanged, and a crash abandons up to k un-journaled
                         tokens per slot that resume must replay exactly
+  CHAOS_SPEC            engine ``speculation`` draft depth (default 0 = off):
+                        k >= 1 serves the whole replay through SPECULATIVE
+                        decoding (docs/serving.md "Speculative decoding") —
+                        every decode dispatch verifies k drafter-proposed
+                        tokens, so quarantine, deadline expiry, and the crash
+                        scenarios all land MID-SPECULATION. The zero-lost /
+                        zero-drift bar is unchanged (greedy speculation is
+                        bit-exact by construction), and a crash abandons up
+                        to k+1 un-journaled accepted tokens per slot that
+                        resume must replay exactly. Mutually exclusive with
+                        CHAOS_SYNC_TOKENS > 1
   CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
                         generate; 0 skips the reference pass
   CHAOS_MESH            "DxM" (e.g. "2x2") replays through a mesh-sharded
@@ -199,6 +210,7 @@ def run(
     trace_path: str | None = None,
     paged: bool = False,
     sync_tokens: int = 1,
+    speculation: int = 0,
 ) -> dict:
     """Replay the trace under injected faults; assert zero lost requests and
     (with ``verify_parity``) zero token drift against solo generate; return
@@ -257,6 +269,7 @@ def run(
         tracer=tracer,
         paged_kv=paged,
         tokens_per_sync=sync_tokens,
+        speculation=speculation or None,
     )
     blocks_free_initial = (engine.memory_stats()["block_pool/blocks_free"]
                            if paged else None)
@@ -351,6 +364,9 @@ def run(
             "prefix_cache": bool(prefix_cache),
             "paged_kv": bool(paged),
             "tokens_per_sync": sync_tokens,
+            "speculation": speculation,
+            "spec_forwards": m.spec_forwards.value,
+            "spec_accept_len_mean": round(m.spec_accept_len.mean, 3),
             "tokens_per_dispatch_mean": round(m.tokens_per_dispatch.mean, 3),
             "blocks_free_initial": blocks_free_initial,
             "mesh": f"{engine.mesh_shape[0]}x{engine.mesh_shape[1]}"
@@ -596,6 +612,7 @@ def _crash_child() -> None:
         journal=os.environ["CHAOS_JOURNAL"],
         paged_kv=bool(_env_int("CHAOS_PAGED", 0)),
         tokens_per_sync=_env_int("CHAOS_SYNC_TOKENS", 1),
+        speculation=_env_int("CHAOS_SPEC", 0) or None,
     )
     if os.environ.get("CHAOS_SCENARIO") == "sigterm":
         install_serving_preemption_handler(
@@ -635,6 +652,7 @@ def run_crash(
     trace_path: str | None = None,
     paged: bool = False,
     sync_tokens: int = 1,
+    speculation: int = 0,
 ) -> dict:
     """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
     fresh engine from what survived on disk, and assert zero lost accepted
@@ -675,6 +693,7 @@ def run_crash(
         CHAOS_PREFIX_BLOCKS=str(prefix_blocks), CHAOS_GRACE=str(grace_s),
         CHAOS_PAGED=str(int(paged)),
         CHAOS_SYNC_TOKENS=str(sync_tokens),
+        CHAOS_SPEC=str(speculation),
         JAX_PLATFORMS="cpu",
     )
     t0 = time.perf_counter()
@@ -733,6 +752,7 @@ def run_crash(
         tracer=tracer,
         paged_kv=paged,
         tokens_per_sync=sync_tokens,
+        speculation=speculation or None,
     )
     report = engine.resume(source)
     # terminal outcome per accepted rid: child finishes from the journal,
@@ -806,6 +826,7 @@ def run_crash(
             "prefix_cache": bool(prefix_cache),
             "paged_kv": bool(paged),
             "tokens_per_sync": sync_tokens,
+            "speculation": speculation,
             "finished_pre_crash": len(scan.finishes),
             "resumed_mid_stream": len(report.resumed),
             "restored_queued": len(report.restored),
@@ -856,6 +877,7 @@ def main() -> None:
             trace_path=os.environ.get("CHAOS_TRACE") or None,
             paged=bool(_env_int("CHAOS_PAGED", 0)),
             sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
+            speculation=_env_int("CHAOS_SPEC", 0),
         )
         print(json.dumps(summary), flush=True)
         return
@@ -885,6 +907,7 @@ def main() -> None:
         trace_path=os.environ.get("CHAOS_TRACE") or None,
         paged=bool(_env_int("CHAOS_PAGED", 0)),
         sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
+        speculation=_env_int("CHAOS_SPEC", 0),
     )
     print(json.dumps(summary), flush=True)
 

@@ -4,9 +4,10 @@
 Reads the JSONL time-series a `TelemetryExporter` writes (``jsonl_path=``,
 or ``BENCH_SERVE_TELEMETRY=path`` on `benchmarks/bench_serving.py`) and
 renders the latest point as a top(1)-style screen: slot/queue occupancy
-bars, decode rate vs goodput, latency percentiles, KV slot-pool and prefix
-block-pool byte accounting, and the capacity headroom estimate — plus a
-sparkline of the decode rate over the trailing window.
+bars, decode rate vs goodput, latency percentiles, speculation accept
+telemetry (when the engine drafts), KV slot-pool and prefix block-pool byte
+accounting, and the capacity headroom estimate — plus a sparkline of the
+decode rate over the trailing window.
 
 One-shot by default (render the latest point and exit); ``--watch N``
 re-reads the file every N seconds until interrupted, like ``top``. All
@@ -117,6 +118,16 @@ def render(point: dict, history: list[dict] | None = None,
     if ttft_p50 is not None:
         lines.append(f"ttft   p50 {1e3 * ttft_p50:.1f} ms, "
                      f"p99 {1e3 * g('serving/ttft_s/p99', 0.0):.1f} ms")
+
+    if g("serving/spec_forwards"):
+        proposed = int(g("serving/spec_proposed", 0))
+        accepted = int(g("serving/spec_accepted", 0))
+        lines.append(
+            f"spec   {g('serving/accepted_tokens_per_forward', 0.0):.2f} "
+            f"tok/forward, accept len mean "
+            f"{g('serving/spec_accept_len/mean', 0.0):.2f}, "
+            f"accept rate {accepted / max(proposed, 1):.0%} "
+            f"({accepted}/{proposed} drafted)")
 
     pool = g("serving/mem/slot_pool_bytes")
     if pool is not None:
