@@ -6,6 +6,16 @@ admission, per-request sampling params, FIFO queue with backpressure, and
 counters/histograms exported through the `tracking.py` tracker interface.
 """
 
+from .cluster import (
+    POLICY_PREFIX,
+    POLICY_ROUND_ROBIN,
+    ROLE_DECODE,
+    ROLE_MIXED,
+    ROLE_PREFILL,
+    ClusterConfig,
+    ReplicaHandle,
+    ServingCluster,
+)
 from .engine import PagedKVConfig, RecoveryReport, ServingEngine
 from .journal import JournalError, JournalScan, RequestJournal
 from .metrics import Counter, Histogram, ServingMetrics
@@ -45,6 +55,14 @@ from .trace import NULL_TRACER, NullTracer, TraceEvent, Tracer
 
 __all__ = [
     "ServingEngine",
+    "ServingCluster",
+    "ClusterConfig",
+    "ReplicaHandle",
+    "ROLE_PREFILL",
+    "ROLE_DECODE",
+    "ROLE_MIXED",
+    "POLICY_PREFIX",
+    "POLICY_ROUND_ROBIN",
     "PagedKVConfig",
     "RecoveryReport",
     "RequestJournal",

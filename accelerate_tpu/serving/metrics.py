@@ -397,3 +397,68 @@ class ServingMetrics:
     def log_to(self, tracker: Any, step: int | None = None) -> None:
         """Emit the snapshot through a `tracking.GeneralTracker`."""
         tracker.log(self.snapshot(), step=step)
+
+
+# Histogram-summary stat suffixes (`Histogram.summary`): naive summation is
+# wrong for every one of these, so `aggregate_snapshots` special-cases them.
+_HIST_WEIGHTED = ("mean", "p50", "p90", "p99")
+_HIST_MIN = ("min",)
+_HIST_MAX = ("max",)
+
+
+def aggregate_snapshots(snapshots: list[dict[str, Any]]) -> dict[str, Any]:
+    """Combine per-replica `ServingMetrics.snapshot` dicts into one
+    cluster-total dict (`serving/cluster.py` metrics view).
+
+    Counters and rates sum — a cluster's tokens/sec IS the sum of its
+    replicas'. Histogram summaries can't: for each ``<base>/<stat>`` family,
+    ``count`` sums, ``min``/``max`` take the extremes, and ``mean``/``p50``/
+    ``p90``/``p99`` take the count-weighted average (exact for the mean; for
+    quantiles an approximation — the per-replica reservoirs aren't merged —
+    which is fine for the dashboards these feed). Ratio keys are recomputed
+    from their summed numerators/denominators (``slo_attainment``,
+    per-class ``attainment``, ``accepted_tokens_per_forward``) rather than
+    averaged blind. Non-numeric values keep the first replica's entry.
+    """
+    present: dict[str, list[tuple[dict[str, Any], Any]]] = {}
+    for snap in snapshots:
+        for key, value in snap.items():
+            present.setdefault(key, []).append((snap, value))
+    out: dict[str, Any] = {}
+    for key, entries in present.items():
+        values = [v for _, v in entries]
+        if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in values):
+            out[key] = values[0]
+            continue
+        base, _, stat = key.rpartition("/")
+        if stat in _HIST_WEIGHTED and base:
+            weights = [snap.get(f"{base}/count", 0) for snap, _ in entries]
+            total = sum(weights)
+            out[key] = (sum(w * v for w, v in zip(weights, values)) / total
+                        if total else 0.0)
+        elif stat in _HIST_MIN and base:
+            out[key] = min(values)
+        elif stat in _HIST_MAX and base:
+            out[key] = max(values)
+        else:
+            out[key] = sum(values)
+    # ratio keys: recompute from the summed components now in `out`
+    forwards = out.get("serving/spec_forwards", 0)
+    if "serving/accepted_tokens_per_forward" in out:
+        out["serving/accepted_tokens_per_forward"] = (
+            out.get("serving/spec_tokens", 0) / forwards if forwards else 0.0)
+    cls_requests = 0
+    cls_attained = 0
+    for key in list(out):
+        if key.startswith("serving/slo/") and key.endswith("/attainment"):
+            base = key[: -len("/attainment")]
+            requests = out.get(f"{base}/requests", 0)
+            attained = out.get(f"{base}/attained", 0)
+            out[key] = attained / requests if requests else 1.0
+            cls_requests += requests
+            cls_attained += attained
+    if "serving/slo_attainment" in out:
+        out["serving/slo_attainment"] = (
+            cls_attained / cls_requests if cls_requests else 1.0)
+    return out

@@ -128,7 +128,12 @@ class FIFOScheduler:
         group would both recompile per mix pattern and push opted-out
         (privacy-scoped) prompts through the block-pool gather path. A
         resumed request (``resume_tokens``) always rides the plain program —
-        its continuation prefill never matches the block pool."""
+        its continuation prefill never matches the block pool. The cluster's
+        journal-backed migration leans on exactly this: a migrated request
+        re-submitted with ``prefill_len > 0`` can land on ANY replica
+        without ever mixing into that replica's cached-admission runs
+        (`serving/cluster.py`; tests/test_cluster.py pins the interaction
+        with ``capacity_fn``)."""
         return (
             self.prefill_bucket_for(request),
             (bool(request.cache_prefix) and not request.resume_tokens)

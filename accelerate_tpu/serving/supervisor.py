@@ -242,6 +242,12 @@ class EngineSupervisor:
         return self._engine
 
     @property
+    def journal_path(self) -> Path:
+        """The write-ahead journal backing every rebuild of this engine —
+        the cluster's migration source of truth (`serving/cluster.py`)."""
+        return self._journal_path
+
+    @property
     def unhealthy(self) -> bool:
         return self._unhealthy
 

@@ -43,6 +43,7 @@ import dataclasses
 import json
 import math
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -91,12 +92,22 @@ def prometheus_name(key: str) -> str:
     return f"{PROM_NAMESPACE}_{name}"
 
 
+# per-replica gauge namespace (serving/cluster.py): a cluster point carries
+# each replica's gauges under this prefix; the Prometheus render turns the
+# prefix into a {replica="i"} label so per-replica and cluster-total series
+# share a metric name without colliding
+_REPLICA_PREFIX = re.compile(r"^replica(\d+)/(.+)$")
+
+
 def to_prometheus_text(values: dict) -> str:
-    """One gauge per numeric entry in text-exposition format (``# TYPE``
-    line + sample line). Strings and non-finite floats are dropped — a
-    scrape must never see ``nan``/``inf`` literals."""
-    lines: list[str] = []
-    for key in sorted(values):
+    """One gauge per numeric entry in text-exposition format. Strings and
+    non-finite floats are dropped — a scrape must never see ``nan``/``inf``
+    literals. A ``replica<i>/``-prefixed key (the cluster's per-replica
+    namespace) renders as the unprefixed metric name with a
+    ``{replica="i"}`` label; every metric name gets exactly one ``# TYPE``
+    line however many labeled samples share it."""
+    by_name: dict[str, list[tuple[str, Any]]] = {}
+    for key in values:
         v = values[key]
         if isinstance(v, bool):
             v = int(v)
@@ -104,16 +115,28 @@ def to_prometheus_text(values: dict) -> str:
             continue
         if isinstance(v, float) and not math.isfinite(v):
             continue
-        name = prometheus_name(key)
+        m = _REPLICA_PREFIX.match(key)
+        if m is not None:
+            name = prometheus_name(m.group(2))
+            label = f'{{replica="{m.group(1)}"}}'
+        else:
+            name = prometheus_name(key)
+            label = ""
+        by_name.setdefault(name, []).append((label, v))
+    lines: list[str] = []
+    for name in sorted(by_name):
         lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {v!r}")
+        # cluster total (no label) first, then replicas in index order
+        for label, v in sorted(by_name[name]):
+            lines.append(f"{name}{label} {v!r}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
 def parse_prometheus_text(text: str) -> dict[str, float]:
-    """Inverse of `to_prometheus_text` (gauges only, no labels) — the
-    round-trip half the format tests rely on. Raises ``ValueError`` on a
-    sample line whose value is not a float literal."""
+    """Inverse of `to_prometheus_text` (gauges only) — the round-trip half
+    the format tests rely on. A labeled sample keeps its label block in the
+    key (``name{replica="0"}``). Raises ``ValueError`` on a sample line
+    whose value is not a float literal."""
     out: dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -222,6 +245,15 @@ class TelemetryExporter:
         if head is not None:
             for k, v in head().items():
                 gauges[f"serving/headroom/{k}"] = v
+        # multi-replica source (`ServingCluster.replica_samples`): each
+        # replica's gauges ride the same point under `replica<i>/...`, so
+        # per-replica and cluster-total series never collide — in JSONL by
+        # key, in Prometheus by the {replica="i"} label the render adds
+        replicas = getattr(engine, "replica_samples", None)
+        if callable(replicas):
+            for i, sub in enumerate(replicas()):
+                for k, v in sub.items():
+                    gauges[f"replica{i}/{k}"] = v
         point = sanitize_scalars(gauges)
         point["_step"] = (int(metrics.steps.value)
                           if metrics is not None else len(self._points))

@@ -76,11 +76,21 @@ EV_STALL = "stall"            # heartbeat went stale past the stall timeout
 EV_RESTART = "restart"        # engine rebuilt + journal-resumed [reason, attempt]
 EV_BROWNOUT = "brownout"      # overload brownout entered/exited [phase, level]
 
+# Cluster edges (serving/cluster.py — docs/serving.md "Multi-replica
+# serving"): ``rid`` is the ENGINE-level id on the replica whose tracer
+# carries the event. Deliberately outside REQUEST_KINDS: a routed request's
+# lifecycle on its replica stays a valid single-engine stream, and the
+# cluster edges annotate placement without perturbing `validate`'s
+# per-request schema.
+EV_ROUTE = "route"            # router placed a submit [replica, policy, resumed]
+EV_MIGRATE = "migrate"        # journal-backed move [from_replica, to_replica, resumed]
+
 TERMINAL_KINDS = frozenset({EV_FINISH, EV_REJECT})
 REQUEST_KINDS = frozenset(
     {EV_SUBMIT, EV_QUEUED, EV_ADMIT, EV_QUARANTINE, EV_FINISH, EV_REJECT}
 )
 SUPERVISOR_KINDS = frozenset({EV_STALL, EV_RESTART, EV_BROWNOUT})
+CLUSTER_KINDS = frozenset({EV_ROUTE, EV_MIGRATE})
 
 
 @dataclass(frozen=True)
@@ -242,6 +252,9 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
       - supervisor edges are well-formed: STALL carries ``elapsed_s``,
         RESTART carries ``reason``/``attempt``, and BROWNOUT ``phase``
         enter/exit markers strictly alternate starting from inactive;
+      - cluster edges are well-formed: ROUTE carries ``replica`` and
+        MIGRATE carries ``from_replica``/``to_replica`` (placement
+        annotations — they never alter per-request stream validity);
       - DISPATCH/FETCH pairs are balanced at every pipeline depth: fetches
         drain strictly in dispatch order (the in-flight queue is FIFO), every
         fetch matches a recorded dispatch, and only a *trailing* run of
@@ -315,6 +328,13 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
                                      f"{'active' if brownout_active else 'inactive'}")
                 else:
                     brownout_active = phase == "enter"
+            # cluster edges (serving/cluster.py): placement annotations
+            # riding alongside the request stream — schema only
+            elif ev.kind == EV_ROUTE and "replica" not in ev.data:
+                anomalies.append("route without replica")
+            elif (ev.kind == EV_MIGRATE
+                  and not {"from_replica", "to_replica"} <= set(ev.data)):
+                anomalies.append("migrate without from_replica/to_replica")
 
         # dispatch/fetch pairing
         dispatch_by_seq: dict[int, TraceEvent] = {}

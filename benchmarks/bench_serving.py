@@ -65,6 +65,29 @@ prompt prefill skipped via reuse; the PR-4 acceptance bar is >= 0.30),
 vs_baseline = tokens_per_sec(on) / tokens_per_sec(off), and detail splits
 TTFT p50/p99 by cache hit vs miss.
 
+``BENCH_SERVE_WORKLOAD=cluster`` measures the multi-replica router
+(`serving/cluster.py`, `docs/serving.md` "Multi-replica serving") and prints
+TWO rows. "serving_cluster_tokens_per_sec": a WEAK-scaling sweep — the
+ragged trace grows with the replica count (``BENCH_SERVE_REQUESTS`` per
+replica, tiled copies of one base trace so the request mix is identical)
+and each replica carries the same load at every ``BENCH_SERVE_REPLICAS``
+count (default 1,2,4). On one host every replica
+shares the same CPU, so the honest claim this row can make is that the
+routing layer conserves per-host throughput: value = tokens/sec at the
+largest count, vs_baseline = largest / 1-replica (≈ 1.0 = the router adds
+no overhead; real fleets give each replica its own accelerator), detail
+carries per-count tokens/sec + TTFT mean/p50/p99.
+"serving_cluster_prefix_routing_hit_rate": a multi-tenant shared-prefix
+trace (``BENCH_SERVE_TENANTS`` distinct system prompts, slow fixed-interval
+arrivals so each tenant's prefix is donated before its next request is
+routed) through a 2-replica cluster of prefix-cached engines, once under
+``policy="prefix"`` and once under ``policy="round_robin"``; value = the
+prefix policy's trie hit rate, vs_baseline = prefix hit rate / round-robin
+hit rate (>1.0 = affinity routing concentrates each tenant on its cache
+holder instead of paying a cold prefill per replica per tenant), detail
+carries both policies' hit rates and mean TTFT (`tools/bench_gate.py`
+treats the ttft detail keys as lower-is-better via its name hints).
+
 Every traced request carries an `SLOSpec`: the short interactive replies get
 TTFT + ITL-p99 bounds (class "interactive"), the heavy-tail requests only
 need a clean finish (class "batch") — so each engine run's detail carries a
@@ -77,14 +100,16 @@ trace's event/drop/malformed counts. Tracing is off (the zero-overhead
 `NULL_TRACER`) unless the knob is set, so the headline numbers are untouched.
 
 Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
-  BENCH_SERVE_REQUESTS     trace length (default 32)
+  BENCH_SERVE_REQUESTS     trace length (default 32; cluster mode: requests
+                           PER REPLICA for the weak-scaling row, default 12)
   BENCH_SERVE_CONCURRENCY  engine slots == lockstep batch size (default 8)
   BENCH_SERVE_RATE         Poisson arrival rate, req/s (default 200: saturating;
                            prefix mode defaults to 8 — unsaturated, see above)
   BENCH_SERVE_SEED         trace rng seed (default 0)
   BENCH_SERVE_DEPTH        pipelined run's pipeline_depth (default 2)
   BENCH_SERVE_ADMIT        admit_batch for both engine runs (default 4)
-  BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system prompt)
+  BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system
+                           prompt) | "cluster" (multi-replica router rows)
   BENCH_SERVE_SYNC         comma list of tokens_per_sync values for the fused
                            decode row (default "1,4"; "" skips the row)
   BENCH_SERVE_FUSED_BATCHES  comma list of engine batch sizes for the fused
@@ -100,8 +125,17 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
                            "ngram" (prompt lookup, default) and/or "model"
                            (tiny same-vocab draft model)
   BENCH_SERVE_SPEC_REQUESTS  speculation-row trace length (default 12)
-  BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64)
+  BENCH_SERVE_PREFIX_LEN   prefix-mode shared prompt length (default 64;
+                           cluster mode reuses it for the tenant prompts)
   BENCH_SERVE_MISS_FRAC    prefix-mode fraction of cold-prefix requests (0.25)
+  BENCH_SERVE_REPLICAS     cluster mode: comma list of replica counts for the
+                           scaling row (default "1,2,4")
+  BENCH_SERVE_TENANTS      cluster mode: distinct shared prefixes in the
+                           routing-policy row's trace (default 5 — odd, so
+                           round-robin placement doesn't alias tenants onto
+                           fixed replicas on the 2-replica cluster)
+  BENCH_SERVE_CLUSTER_DIR  cluster mode: workdir root for the replicas'
+                           journals (default: a fresh temp dir, removed after)
   BENCH_SERVE_MESH         mesh sweep instead: comma-separated (data, model)
                            shapes, e.g. "1x1,2x1,1x2,2x2" — the ragged trace
                            runs once per shape through `ServingEngine(mesh=...)`
@@ -125,7 +159,9 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -678,6 +714,239 @@ def main_prefix() -> None:
     }), flush=True)
 
 
+def _run_cluster(cluster, trace) -> tuple[float, float, dict]:
+    """`_run_engine` at the cluster surface: same arrival pacing, same
+    accounting, but TTFT/occupancy come from the cluster's aggregated
+    snapshot (`serving/metrics.py` aggregate_snapshots) instead of one
+    engine's metrics object."""
+    for rep in cluster.replicas:
+        rep.metrics.reset_rate_window()
+    t0 = time.perf_counter()
+    pending = list(trace)
+    done = 0
+    while pending or cluster.has_work:
+        now = time.perf_counter() - t0
+        while pending and pending[0].arrival_time <= now:
+            req = pending.pop(0)
+            res = cluster.submit(Request(req.prompt, req.params, slo=req.slo))
+            assert res.accepted, (res.reason, res.detail)
+        done += len(cluster.step())
+        if not cluster.has_work and pending:
+            time.sleep(max(0.0, pending[0].arrival_time - (time.perf_counter() - t0)))
+    dt = time.perf_counter() - t0
+    tokens = sum(r.params.max_new_tokens for r in trace)
+    assert done == len(trace)
+    snap = cluster.metrics.snapshot()
+    return tokens / dt, dt, {
+        "ttft_mean_s": round(snap.get("serving/ttft_s/mean", 0.0), 4),
+        "ttft_p50_s": round(snap.get("serving/ttft_s/p50", 0.0), 4),
+        "ttft_p99_s": round(snap.get("serving/ttft_s/p99", 0.0), 4),
+        "itl_p50_s": round(snap.get("serving/inter_token_s/p50", 0.0), 5),
+        "prefix_hits": int(snap.get("serving/prefix_hits", 0)),
+        "prefix_misses": int(snap.get("serving/prefix_misses", 0)),
+        "routed_prefix": int(snap.get("cluster/routed_prefix", 0)),
+        "routed_round_robin": int(snap.get("cluster/routed_round_robin", 0)),
+        "route_match_tokens": int(snap.get("cluster/route_match_tokens", 0)),
+        "steps": int(snap.get("serving/steps", 0)),
+    }
+
+
+def _tenant_trace(n: int, rate: float, seed: int, vocab: int, prefix_len: int,
+                  tenants: int) -> list[Request]:
+    """Multi-tenant `_prefix_trace`: ``tenants`` distinct shared prefixes,
+    requests round-robining over them. Prefix-aware placement keeps each
+    tenant's stream on the replica whose trie holds its prefix; round-robin
+    placement scatters every tenant across all replicas, so each replica
+    pays its own cold prefill per tenant — the hit-rate delta this row
+    measures. Arrivals are FIXED-interval (1/rate apart), not Poisson: the
+    row needs "a tenant's prefix is donated before that tenant returns" to
+    hold by construction, and an exponential gap puts a fat left tail on
+    exactly that precondition."""
+    r = np.random.default_rng(seed)
+    prefixes = [r.integers(0, vocab, (prefix_len,)).astype(np.int32).tolist()
+                for _ in range(tenants)]
+    t, reqs = 0.0, []
+    for i in range(n):
+        t += 1.0 / rate
+        tail = r.integers(0, vocab, (int(r.integers(4, 13)),)).astype(np.int32).tolist()
+        reqs.append(Request(
+            prompt=prefixes[i % tenants] + tail,
+            params=SamplingParams(max_new_tokens=int(r.integers(8, 17))),
+            arrival_time=t,
+        ))
+    return reqs
+
+
+def main_cluster() -> None:
+    from accelerate_tpu.serving import (
+        ClusterConfig,
+        PrefixCacheConfig,
+        ServingCluster,
+    )
+
+    # requests PER REPLICA: the scaling row is a weak-scaling sweep, so the
+    # trace grows with the count and every replica carries the same load
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 12)
+    concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 4)
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 200.0))
+    seed = _env_int("BENCH_SERVE_SEED", 0)
+    depth = _env_int("BENCH_SERVE_DEPTH", 2)
+    admit = _env_int("BENCH_SERVE_ADMIT", 4)
+    prefix_len = _env_int("BENCH_SERVE_PREFIX_LEN", 64)
+    # odd on purpose: with 2 replicas an even tenant count aliases every
+    # tenant onto one fixed replica under round-robin (i % tenants and
+    # i % 2 never decouple), hiding the miss cost affinity routing avoids
+    tenants = _env_int("BENCH_SERVE_TENANTS", 5)
+    counts = [int(tok) for tok in
+              os.environ.get("BENCH_SERVE_REPLICAS", "1,2,4").split(",") if tok]
+
+    cfg = GPT2Config(vocab_size=2048, n_positions=128, n_embd=512, n_layer=6,
+                     n_head=8, dtype=jnp.float32, param_dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+
+    base_dir = os.environ.get("BENCH_SERVE_CLUSTER_DIR")
+    tmp_dir = None
+    if base_dir is None:
+        tmp_dir = base_dir = tempfile.mkdtemp(prefix="bench_cluster_")
+
+    def timed_cluster(tag, n_reps, warm, trace, factory, policy):
+        # warm cluster compiles every program (replicas share module/params,
+        # so the process jit cache carries over); the timed cluster starts
+        # with clean metrics, clean tries, and a fresh journal workdir
+        results = None
+        for phase, tr in (("warm", warm), ("timed", trace)):
+            cluster = ServingCluster(
+                factory, os.path.join(base_dir, f"{tag}-{phase}"),
+                replicas=n_reps, config=ClusterConfig(policy=policy))
+            try:
+                results = _run_cluster(cluster, tr)
+            finally:
+                cluster.close()
+        return results
+
+    try:
+        # --- row 1: weak-scaling sweep on the ragged trace ----------------
+        # trace size grows with the count so per-replica load is constant;
+        # on one shared-CPU host the replicas split the same device, so the
+        # honest claim is throughput CONSERVATION (vs_baseline ~ 1.0 = the
+        # router adds no overhead), not compute scaling. The per-count trace
+        # TILES one base trace (fresh arrival clock, same prompts/budgets)
+        # so every count serves the identical request mix — independent
+        # draws at small n skew the short/heavy split and fake a scaling
+        # win or loss
+        max_queue = n_requests * max(counts) + 1
+        base = _trace(n_requests, rate, seed, cfg.vocab_size)
+        warm_base = _trace(n_requests, rate, seed + 1, cfg.vocab_size)
+
+        def tiled(breqs, n_copies, arrival_seed):
+            r = np.random.default_rng(arrival_seed)
+            t, out = 0.0, []
+            for _ in range(n_copies):
+                for req in breqs:
+                    t += float(r.exponential(1.0 / rate))
+                    out.append(Request(req.prompt, req.params,
+                                       arrival_time=t, slo=req.slo))
+            return out
+
+        def slot_factory(**kw):
+            return ServingEngine(
+                module, params, max_concurrency=concurrency,
+                prompt_buckets=BUCKETS, max_queue=max_queue,
+                pipeline_depth=depth, admit_batch=admit, **kw)
+
+        scale_rows: dict[str, dict] = {}
+        for n_reps in counts:
+            trace = tiled(base, n_reps, seed)
+            warm = tiled(warm_base, n_reps, seed + 1)
+            tps, dt, detail = timed_cluster(
+                f"scale{n_reps}", n_reps, warm, trace, slot_factory,
+                ClusterConfig().policy)
+            scale_rows[str(n_reps)] = {
+                "tokens_per_sec": round(tps, 2), "wall_s": round(dt, 3),
+                "requests": len(trace), **detail}
+        first = scale_rows[str(counts[0])]["tokens_per_sec"]
+        last = scale_rows[str(counts[-1])]
+        print(json.dumps({
+            "metric": "serving_cluster_tokens_per_sec",
+            "value": last["tokens_per_sec"],
+            "unit": "tokens/s",
+            "vs_baseline": round(last["tokens_per_sec"] / max(first, 1e-9), 3),
+            "detail": {
+                "platform": jax.devices()[0].platform,
+                "requests_per_replica": n_requests,
+                "concurrency_per_replica": concurrency,
+                "poisson_rate": rate,
+                "pipeline_depth": depth,
+                "admit_batch": admit,
+                "replica_counts": counts,
+                "ttft_mean_1r_s": scale_rows[str(counts[0])]["ttft_mean_s"],
+                "ttft_mean_max_s": last["ttft_mean_s"],
+                "replicas": scale_rows,
+            },
+        }), flush=True)
+
+        # --- row 2: prefix routing vs round-robin, 2 replicas -------------
+        # slow arrivals on purpose, twice over: (a) unsaturated (same
+        # reasoning as main_prefix) so TTFT is prefill latency, not queue
+        # wait; (b) a tenant's next request must arrive AFTER its previous
+        # one finished and donated its prefix, or the router probes empty
+        # tries and every policy degenerates to load placement. 0.5 req/s
+        # with 5 tenants = one same-tenant return every 10 s, comfortably
+        # past a cold request's few-second CPU service time
+        route_rate = 0.5
+        route_requests = n_requests * 2
+        buckets = (16, prefix_len + 16)
+        rtrace = _tenant_trace(route_requests, route_rate, seed,
+                               cfg.vocab_size, prefix_len, tenants)
+        # different seed -> different tenant prefixes: warms programs, not
+        # the timed trace's tries (the timed cluster is fresh anyway); high
+        # rate because the warm pass only exists to compile
+        rwarm = _tenant_trace(route_requests, 200.0, seed + 1,
+                              cfg.vocab_size, prefix_len, tenants)
+
+        def cached_factory(**kw):
+            return ServingEngine(
+                module, params, max_concurrency=concurrency,
+                prompt_buckets=buckets, max_queue=len(rtrace) + 1,
+                pipeline_depth=depth, admit_batch=admit,
+                prefix_cache=PrefixCacheConfig(), **kw)
+
+        policy_rows: dict[str, dict] = {}
+        for policy in ("prefix", "round_robin"):
+            tps, dt, detail = timed_cluster(
+                f"route-{policy}", 2, rwarm, rtrace, cached_factory, policy)
+            hits, misses = detail["prefix_hits"], detail["prefix_misses"]
+            policy_rows[policy] = {
+                "tokens_per_sec": round(tps, 2), "wall_s": round(dt, 3),
+                "hit_rate": round(hits / max(hits + misses, 1), 4),
+                **detail}
+        pfx, rr = policy_rows["prefix"], policy_rows["round_robin"]
+        print(json.dumps({
+            "metric": "serving_cluster_prefix_routing_hit_rate",
+            "value": pfx["hit_rate"],
+            "unit": "trie_hit_frac",
+            "vs_baseline": round(pfx["hit_rate"] / max(rr["hit_rate"], 1e-9),
+                                 3),
+            "detail": {
+                "platform": jax.devices()[0].platform,
+                "requests": route_requests,
+                "replicas": 2,
+                "tenants": tenants,
+                "prefix_len": prefix_len,
+                "arrival_rate": route_rate,
+                "hit_rate_round_robin": rr["hit_rate"],
+                "ttft_mean_prefix_s": pfx["ttft_mean_s"],
+                "ttft_mean_round_robin_s": rr["ttft_mean_s"],
+                "prefix": pfx,
+                "round_robin": rr,
+            },
+        }), flush=True)
+    finally:
+        if tmp_dir is not None:
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+
+
 def main_mesh() -> None:
     """Per-mesh-shape serving rows: the SAME ragged trace through
     ``ServingEngine(mesh=(d, m))`` for every requested shape. One JSON row per
@@ -778,8 +1047,12 @@ def main() -> None:
     if os.environ.get("BENCH_SERVE_MESH"):
         main_mesh()
         return
-    if os.environ.get("BENCH_SERVE_WORKLOAD", "ragged") == "prefix":
+    workload = os.environ.get("BENCH_SERVE_WORKLOAD", "ragged")
+    if workload == "prefix":
         main_prefix()
+        return
+    if workload == "cluster":
+        main_cluster()
         return
     n_requests = _env_int("BENCH_SERVE_REQUESTS", 32)
     concurrency = _env_int("BENCH_SERVE_CONCURRENCY", 8)

@@ -89,6 +89,12 @@ def pytest_configure(config):
         "block-table rollback — docs/serving.md \"Speculative decoding\") — "
         "run standalone with `pytest -m speculation`",
     )
+    config.addinivalue_line(
+        "markers",
+        "cluster: multi-replica serving cluster tests (prefix/health-aware "
+        "routing, journal-backed migration — docs/serving.md \"Multi-replica "
+        "serving\") — run standalone with `pytest -m cluster`",
+    )
 
 
 @pytest.fixture

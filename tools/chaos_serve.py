@@ -99,7 +99,17 @@ Env knobs:
                         dispatch / a NaN quarantine storm that the engine
                         SUPERVISOR — not this harness — must detect and
                         recover via automatic journal-backed restart, with
-                        zero lost requests and zero token drift
+                        zero lost requests and zero token drift;
+                        "replica_kill" runs the MULTI-REPLICA scenario
+                        (`serving/cluster.py`): a `ServingCluster` of
+                        CHAOS_REPLICAS zero-restart-budget replicas takes a
+                        deterministic device loss, the hit replica dies, and
+                        the CLUSTER must migrate its journaled backlog onto
+                        the survivors with resume_tokens — zero lost, zero
+                        drift, clean `journal_fsck --all` over the workdir
+  CHAOS_REPLICAS        replica_kill scenario: cluster size (default 2)
+  CHAOS_WORKDIR         replica_kill scenario: cluster workdir holding each
+                        replica's journal (default: a fresh temp dir)
   CHAOS_RESTART_BUDGET  hang/storm scenarios: the supervisor's max_restarts
                         (default 3). 0 asserts the fail-fast contract
                         instead: first failure goes straight to unhealthy,
@@ -584,6 +594,178 @@ def run_supervised(
     }
 
 
+def run_replica_kill(
+    n_replicas: int = 2,
+    n_requests: int = 16,
+    concurrency: int = 2,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    verify_parity: bool = True,
+    trace_path: str | None = None,
+    workdir: str | None = None,
+) -> dict:
+    """Multi-replica kill scenario (``CHAOS_SCENARIO=replica_kill``,
+    ``CHAOS_REPLICAS=n``): the whole trace runs through a `ServingCluster`
+    with every replica on a ZERO restart budget, and an injected device loss
+    kills whichever replica's dispatch it lands on — budget exhausted, the
+    supervisor fails it loud, and the CLUSTER (not this harness) must
+    migrate the dead replica's journaled backlog onto the survivors with
+    ``resume_tokens``. Asserts zero lost requests, zero token drift vs solo
+    generate for every clean finish — including the migrated mid-stream
+    continuations — plus clean journals under `tools/journal_fsck.py`'s
+    ``--all`` sweep and steady-state gauges on every surviving replica."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.reliability import FaultInjector, FaultSpec, inject
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        Request,
+        ServingCluster,
+        ServingEngine,
+        SupervisorConfig,
+        Tracer,
+    )
+
+    if n_replicas < 2:
+        raise ValueError("replica_kill needs CHAOS_REPLICAS >= 2 "
+                         "(a survivor must exist to migrate onto)")
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_cluster_")
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    trace = _trace(n_requests, 1e9, seed, int(module.config.vocab_size))
+
+    # one device loss, deterministically scheduled (several candidate
+    # dispatch indices, one firing): whichever replica's dispatch it lands
+    # on dies — budget 0 means the first failure exhausts the ladder
+    injector = FaultInjector(seed=seed, specs=[
+        FaultSpec.device_error(at_calls=tuple(range(8, 400, 9)),
+                               max_faults=1)])
+    tracers = [Tracer() for _ in range(n_replicas)] if trace_path else None
+
+    def factory(**kw):
+        return ServingEngine(
+            module, params, max_concurrency=concurrency,
+            prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+            pipeline_depth=pipeline_depth, **kw,
+        )
+
+    cluster = ServingCluster(
+        factory, workdir, replicas=n_replicas,
+        supervisor_config=SupervisorConfig(max_restarts=0),
+        tracers=tracers)
+    t0 = time.perf_counter()
+    submitted: list[int] = []
+    shed = 0
+    terminal: dict[int, str] = {}
+    outputs: dict[int, list[int]] = {}
+    req_by_id: dict[int, object] = {}
+    with inject(injector):
+        for src in trace:
+            result = cluster.submit(Request(src.prompt, src.params))
+            if result.accepted:
+                submitted.append(result.request_id)
+                req_by_id[result.request_id] = src
+            else:
+                shed += 1
+        while cluster.has_work:
+            for out in cluster.step():
+                terminal[out.request_id] = out.finish_reason
+                outputs[out.request_id] = out.tokens
+
+    dead = [rep.index for rep in cluster.replicas if not rep.healthy]
+    assert dead, "the injected device loss never landed — no replica died"
+    assert len(dead) < n_replicas, "every replica died; nothing to migrate to"
+    assert cluster.migrations >= 1, \
+        f"dead replica(s) {dead} but the cluster never migrated"
+    lost = sorted(set(submitted) - set(terminal))
+    assert not lost, f"lost requests across replica kill: {lost}"
+
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, reason in sorted(terminal.items()):
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            src = req_by_id[rid]
+            ids = jnp.asarray(np.asarray(src.prompt, np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=src.params.max_new_tokens,
+                temperature=src.params.temperature, top_k=src.params.top_k,
+                rng=jax.random.key(src.params.seed),
+            )
+            checked += 1
+            if outputs[rid] != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, \
+            f"token drift across replica-kill migration: {drift}"
+
+    # the cluster workdir's journals must audit clean as a set — the same
+    # sweep an operator runs (tools/journal_fsck.py --all WORKDIR)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from journal_fsck import fsck_all  # noqa: E402
+    fsck_report, fsck_code = fsck_all(workdir)
+    assert fsck_code == 0, f"journal fsck --all failed: {fsck_report}"
+    assert fsck_report["journals"] == n_replicas, fsck_report
+
+    for rep in cluster.replicas:
+        if rep.healthy:
+            _assert_steady_state(rep.engine)
+
+    trace_summary = None
+    if tracers is not None:
+        from trace_report import multi_report  # tools/ is on sys.path now
+        os.makedirs(trace_path, exist_ok=True)
+        paths = []
+        for i, tr in enumerate(tracers):
+            exported = tr.export(os.path.join(
+                trace_path, f"replica{i}.trace.json"))
+            paths.append(exported["path"])
+        combined = multi_report(paths, top=3)
+        assert combined["clean"], f"trace anomalies: {combined}"
+        trace_summary = {"paths": paths, "events": combined["events"]}
+
+    reasons: dict[str, int] = {}
+    for reason in terminal.values():
+        reasons[reason] = reasons.get(reason, 0) + 1
+    snap = cluster.metrics.snapshot()
+    cluster.close()
+    return {
+        "metric": "chaos_serve_cluster_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "scenario": "replica_kill",
+            "replicas": n_replicas,
+            "dead_replicas": dead,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "migrations": cluster.migrations,
+            "migrated_requests": cluster.migrated_requests,
+            "routed_prefix": snap["cluster/routed_prefix"],
+            "routed_round_robin": snap["cluster/routed_round_robin"],
+            "shed_requests": shed,
+            "faults_fired": [(e.scope, e.call_index, e.kind)
+                             for e in injector.fired],
+            "terminal_reasons": reasons,
+            "parity_checked": checked,
+            "parity_drift": len(drift),
+            "journals_clean": fsck_report["clean_journals"],
+            "trace": trace_summary,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
 def _crash_child() -> None:
     """Child half of the crash scenarios: serve the trace with a journal (and,
     under sigterm, a drain-or-snapshot preemption handler) until killed."""
@@ -848,6 +1030,19 @@ def run_crash(
 def main() -> None:
     if os.environ.get("CHAOS_CRASH_CHILD"):
         _crash_child()
+        return
+    if os.environ.get("CHAOS_SCENARIO", "").lower() == "replica_kill":
+        summary = run_replica_kill(
+            n_replicas=_env_int("CHAOS_REPLICAS", 2),
+            n_requests=_env_int("CHAOS_REQUESTS", 16),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+            trace_path=os.environ.get("CHAOS_TRACE") or None,
+            workdir=os.environ.get("CHAOS_WORKDIR") or None,
+        )
+        print(json.dumps(summary), flush=True)
         return
     if os.environ.get("CHAOS_SCENARIO", "").lower() in ("hang", "storm"):
         summary = run_supervised(

@@ -14,8 +14,16 @@ is still clean), 1 = mid-file anomalies (records out of order, unknown types,
 tokens for never-submitted rids — a crash cannot explain these), 2 = not a
 journal at all (bad magic / unreadable).
 
+``--all DIR`` audits every ``*.journal`` under a directory tree — the shape
+a `ServingCluster` workdir leaves behind (``replica{i}/requests.journal``
+per replica) — and reports one aggregate line whose exit status is the
+WORST per-file status, so one command answers "is this whole cluster's
+durable state sound".
+
 Run:
     JAX_PLATFORMS=cpu python tools/journal_fsck.py PATH [--compact]
+        [--keep-finished]
+    JAX_PLATFORMS=cpu python tools/journal_fsck.py --all DIR [--compact]
         [--keep-finished]
 """
 
@@ -25,6 +33,7 @@ import argparse
 import json
 import os
 import sys
+from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -59,9 +68,50 @@ def fsck(path: str, *, compact: bool = False, keep_finished: bool = False) -> di
     return report
 
 
+def fsck_all(directory: str, *, compact: bool = False,
+             keep_finished: bool = False) -> tuple[dict, int]:
+    """Audit every ``*.journal`` under ``directory`` (recursive — a cluster
+    workdir keeps one per ``replica{i}/`` subdir). Returns ``(aggregate
+    report, exit code)``: per-file reports (unreadable files become
+    ``{"path", "error"}`` entries instead of aborting the sweep) and the
+    aggregate exit code is the WORST per-file code — 2 when any file is not
+    a journal or the directory holds none at all."""
+    paths = sorted(Path(directory).rglob("*.journal"))
+    if not paths:
+        return ({"path": str(directory),
+                 "error": "no *.journal files found"}, 2)
+    reports: list[dict] = []
+    code = 0
+    for path in paths:
+        try:
+            rep = fsck(str(path), compact=compact,
+                       keep_finished=keep_finished)
+        except (JournalError, OSError) as exc:
+            reports.append({"path": str(path), "error": str(exc)})
+            code = 2
+            continue
+        reports.append(rep)
+        if not rep["clean"]:
+            code = max(code, 1)
+    return ({
+        "path": str(directory),
+        "journals": len(paths),
+        "clean_journals": sum(1 for r in reports if r.get("clean")),
+        "submitted": sum(r.get("submitted", 0) for r in reports),
+        "finished": sum(r.get("finished", 0) for r in reports),
+        "in_flight": sum(len(r.get("in_flight", ())) for r in reports),
+        "reports": reports,
+        "clean": code == 0,
+    }, code)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("path", help="journal file to audit")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="journal file to audit")
+    parser.add_argument("--all", metavar="DIR", default=None,
+                        help="audit every *.journal under DIR (recursive); "
+                             "exit with the worst per-file status")
     parser.add_argument("--compact", action="store_true",
                         help="rewrite in place: collapse progress chains, "
                              "drop finished requests")
@@ -69,6 +119,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="with --compact: keep finished requests' "
                              "terminal records")
     args = parser.parse_args(argv)
+    if (args.path is None) == (args.all is None):
+        parser.error("give exactly one of PATH or --all DIR")
+    if args.all is not None:
+        report, code = fsck_all(args.all, compact=args.compact,
+                                keep_finished=args.keep_finished)
+        print(json.dumps(report), flush=True)
+        return code
     try:
         report = fsck(args.path, compact=args.compact,
                       keep_finished=args.keep_finished)

@@ -24,10 +24,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 
 _SPARK = " .:-=+*#%@"
+
+# per-replica gauge namespace a ServingCluster point carries
+# (serving/telemetry.py `replica<i>/...` keys)
+_REPLICA_KEY = re.compile(r"^replica(\d+)/(.+)$")
 
 
 def load_points(path: str) -> list[dict]:
@@ -173,6 +178,40 @@ def render(point: dict, history: list[dict] | None = None,
             f"storms {g('supervisor/storms_detected', 0)}), "
             f"shed {g('supervisor/shed_requests', 0)}, "
             f"brownout {brownout}")
+
+    # multi-replica points (serving/cluster.py): a cluster-total line plus
+    # one health/occupancy row per replica<i>/ namespace. The totals above
+    # already aggregate across replicas — this section shows the split.
+    replicas: dict[int, dict] = {}
+    for k, v in point.items():
+        m = _REPLICA_KEY.match(k)
+        if m is not None:
+            replicas.setdefault(int(m.group(1)), {})[m.group(2)] = v
+    if replicas:
+        healthy = sum(1 for sub in replicas.values()
+                      if sub.get("cluster/healthy", 1))
+        lines.append(
+            f"cluster {healthy}/{len(replicas)} replicas healthy, "
+            f"{int(g('cluster/migrations', 0))} migration(s), "
+            f"{int(g('cluster/migrated_requests', 0))} request(s) moved, "
+            f"routed prefix {int(g('cluster/routed_prefix', 0))} / "
+            f"rr {int(g('cluster/routed_round_robin', 0))}")
+        for i in sorted(replicas):
+            r = replicas[i].get
+            if not r("cluster/healthy", 1):
+                lines.append(f"  r{i} [{r('cluster/role', '?'):<7}] DEAD   "
+                             f"restarts {int(r('cluster/restarts', 0))}")
+                continue
+            total = r("serving/mem/slots_total") or 0
+            active = r("serving/mem/slots_active") or 0
+            occ = f"{int(active)}/{int(total)} slots" if total else "slots ?"
+            level = int(r("cluster/brownout_level", 0))
+            state = f"BROWNOUT L{level}" if level else "ok"
+            lines.append(
+                f"  r{i} [{r('cluster/role', '?'):<7}] {state:<12}"
+                f"{r('serving/tokens_per_sec', 0.0):>8.1f} tok/s  {occ}, "
+                f"queue {int(r('serving/mem/queue_depth', 0) or 0)}, "
+                f"restarts {int(r('cluster/restarts', 0))}")
     return "\n".join(lines)
 
 

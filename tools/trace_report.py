@@ -24,8 +24,15 @@ Exit status: 0 = clean trace, 1 = malformed spans (invariant violations —
 an engine bug, not a viewer problem), 2 = not a trace file at all
 (unreadable / not our export format).
 
+Multiple paths — the shape a `ServingCluster` run leaves, one trace per
+replica — report per-file sections with request ids prefixed ``r<i>:`` (ids
+are per-ENGINE, so the prefix is what keeps replica 0's rid 3 distinct from
+replica 1's), followed by a combined summary and a cross-replica slowest
+list. Exit status is the worst per-file status.
+
 Run:
-    python tools/trace_report.py PATH [--top N] [--no-slots] [--json]
+    python tools/trace_report.py PATH [PATH ...] [--top N] [--no-slots]
+        [--json]
 
 (All the analysis is host-side JSON arithmetic — nothing here touches a
 device; the only accelerate_tpu import is the trace module itself.)
@@ -235,6 +242,35 @@ def report(path: str, *, top: int = 5, slots: bool = True) -> dict:
     }
 
 
+def multi_report(paths: list[str], *, top: int = 5, slots: bool = True) -> dict:
+    """Per-file `report` over a cluster's per-replica traces, with every
+    request id prefixed ``r<i>:`` (engine-level ids collide across replicas;
+    the prefix is the cluster-level name), plus a combined roll-up and a
+    cross-replica slowest list. Raises like `report` on the FIRST unreadable
+    path — partial cluster reports would hide a missing replica."""
+    reports: list[dict] = []
+    for i, path in enumerate(paths):
+        rep = report(path, top=top, slots=slots)
+        rep["replica"] = i
+        for row in rep["slowest"]:
+            row["rid"] = f"r{i}:{row['rid']}"
+        reports.append(rep)
+    slowest = sorted(
+        (row for rep in reports for row in rep["slowest"]),
+        key=lambda row: -row["total_s"],
+    )[: max(0, top)]
+    return {
+        "paths": [str(p) for p in paths],
+        "reports": reports,
+        "events": sum(r["events"] for r in reports),
+        "requests": sum(r["requests"] for r in reports),
+        "dropped": sum(r["dropped"] for r in reports),
+        "malformed_spans": sum(r["malformed_spans"] for r in reports),
+        "slowest": slowest,
+        "clean": all(r["clean"] for r in reports),
+    }
+
+
 def _print_slo(rep: dict) -> None:
     slo = rep["slo"]
     print(f"\nSLO attainment ({slo['slo_requests']} classed requests, "
@@ -292,8 +328,10 @@ def _print_text(rep: dict) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("path", help="trace-event JSON written by "
-                                     "serving.Tracer.export")
+    parser.add_argument("paths", nargs="+", metavar="PATH",
+                        help="trace-event JSON written by "
+                             "serving.Tracer.export (several = one per "
+                             "cluster replica, rids prefixed r<i>:)")
     parser.add_argument("--top", type=int, default=5,
                         help="how many slowest requests to list (default 5)")
     parser.add_argument("--no-slots", action="store_true",
@@ -304,18 +342,47 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="print the full report as JSON instead of text")
     args = parser.parse_args(argv)
+    if len(args.paths) == 1:
+        try:
+            rep = report(args.paths[0], top=args.top, slots=not args.no_slots)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            print(json.dumps({"path": args.paths[0], "error": str(exc)}),
+                  flush=True)
+            return 2
+        if args.json:
+            print(json.dumps(rep), flush=True)
+        else:
+            _print_text(rep)
+            if args.slo:
+                _print_slo(rep)
+        return 0 if rep["clean"] else 1
     try:
-        rep = report(args.path, top=args.top, slots=not args.no_slots)
+        combined = multi_report(args.paths, top=args.top,
+                                slots=not args.no_slots)
     except (OSError, ValueError, KeyError, TypeError) as exc:
-        print(json.dumps({"path": args.path, "error": str(exc)}), flush=True)
+        print(json.dumps({"paths": args.paths, "error": str(exc)}),
+              flush=True)
         return 2
     if args.json:
-        print(json.dumps(rep), flush=True)
-    else:
+        print(json.dumps(combined), flush=True)
+        return 0 if combined["clean"] else 1
+    for rep in combined["reports"]:
+        print(f"=== replica {rep['replica']}: {rep['path']} ===")
         _print_text(rep)
         if args.slo:
             _print_slo(rep)
-    return 0 if rep["clean"] else 1
+        print()
+    print(f"cluster: {len(combined['reports'])} traces, "
+          f"{combined['events']} events, {combined['requests']} requests, "
+          f"dropped={combined['dropped']}, "
+          f"malformed_spans={combined['malformed_spans']}")
+    if combined["slowest"]:
+        print(f"top {len(combined['slowest'])} slowest across replicas:")
+        for row in combined["slowest"]:
+            print(f"  rid {row['rid']:>8} {row['terminal']}:{row['reason']} "
+                  f"({row['tokens']} tok) — "
+                  f"total {1e3 * row['total_s']:.2f} ms")
+    return 0 if combined["clean"] else 1
 
 
 if __name__ == "__main__":
