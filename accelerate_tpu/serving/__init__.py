@@ -6,6 +6,12 @@ admission, per-request sampling params, FIFO queue with backpressure, and
 counters/histograms exported through the `tracking.py` tracker interface.
 """
 
+from .anomaly import (
+    NULL_ANOMALY,
+    AnomalyConfig,
+    AnomalyMonitor,
+    NullAnomalyMonitor,
+)
 from .cluster import (
     POLICY_PREFIX,
     POLICY_ROUND_ROBIN,
@@ -16,7 +22,7 @@ from .cluster import (
     ReplicaHandle,
     ServingCluster,
 )
-from .engine import PagedKVConfig, RecoveryReport, ServingEngine
+from .engine import PagedKVConfig, RecoveryReport, ServingEngine, StepTimings
 from .journal import JournalError, JournalScan, RequestJournal
 from .metrics import Counter, Histogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixCacheConfig
@@ -65,6 +71,11 @@ __all__ = [
     "POLICY_ROUND_ROBIN",
     "PagedKVConfig",
     "RecoveryReport",
+    "StepTimings",
+    "AnomalyConfig",
+    "AnomalyMonitor",
+    "NullAnomalyMonitor",
+    "NULL_ANOMALY",
     "RequestJournal",
     "JournalScan",
     "JournalError",

@@ -327,14 +327,17 @@ class ServingCluster:
             self._rr += 1
             rotated = [candidates[(start + j) % len(candidates)]
                        for j in range(len(candidates))]
+            self._last_rank = {rep.index: {"match_len": 0} for rep in rotated}
             return rotated
         scored = []
+        self._last_rank = {}
         for rep in candidates:
             cache = getattr(rep.engine, "prefix_cache", None)
             match = (cache.match_len(request.prompt)
                      if cache is not None and request.cache_prefix else 0)
             load = (rep.engine.scheduler.queue_depth
                     + rep.engine.active_slots)
+            self._last_rank[rep.index] = {"match_len": match, "load": load}
             scored.append((-match, load, rep.index, rep))
         scored.sort(key=lambda t: t[:3])
         if scored and -scored[0][0] > 0:
@@ -364,7 +367,7 @@ class ServingCluster:
             return SubmitResult(False, None, REJECT_OVERLOAD,
                                 "every healthy replica is shedding load")
         last: SubmitResult | None = None
-        for rep in candidates:
+        for rank, rep in enumerate(candidates):
             result = rep.supervisor.submit(request)
             if result.accepted:
                 rid = self._next_rid
@@ -374,10 +377,29 @@ class ServingCluster:
                              else self.config.policy] += 1
                 tracer = getattr(rep.engine, "tracer", None)
                 if tracer is not None and tracer.enabled:
+                    # routing forensics: how many replicas were in the race,
+                    # the chosen one's trie match, and WHY it won — "fallback:"
+                    # prefixes the reason when earlier-ranked replicas
+                    # rejected and placement fell through to this one
+                    info = getattr(self, "_last_rank", {}).get(rep.index, {})
+                    match_len = int(info.get("match_len", 0))
+                    if resumed:
+                        reason = "resumed"
+                    elif self.config.policy == POLICY_ROUND_ROBIN:
+                        reason = "round_robin"
+                    elif match_len > 0:
+                        reason = "prefix_match"
+                    else:
+                        reason = "load_tiebreak"
+                    if rank > 0:
+                        reason = f"fallback:{reason}"
                     tracer.emit(EV_ROUTE, result.request_id,
                                 replica=rep.index,
                                 policy=self.config.policy,
-                                resumed=resumed)
+                                resumed=resumed,
+                                candidates=len(candidates),
+                                match_len=match_len,
+                                reason=reason)
                 return SubmitResult(True, rid)
             last = result
         return SubmitResult(False, None, last.reason, last.detail)

@@ -85,6 +85,7 @@ from ..parallel.sharding import (
     shard_params,
 )
 from ..reliability.faults import ALL_SLOTS, active_injector
+from .anomaly import NULL_ANOMALY
 from .journal import MAGIC as JOURNAL_MAGIC
 from .journal import JournalScan, RequestJournal, request_record
 from .metrics import ServingMetrics
@@ -159,6 +160,40 @@ class _Inflight:
     # decode iterations this dispatch ran (tokens_per_sync); the fetched
     # arrays are stacked [tokens, b] when > 1, plain [b] when 1
     tokens: int = 1
+
+
+_STEP_PHASES = ("schedule_s", "draft_s", "dispatch_s", "fetch_blocked_s",
+                "deliver_s", "journal_s", "telemetry_s", "total_s")
+
+
+@dataclasses.dataclass
+class StepTimings:
+    """Host wall-time breakdown of ONE `ServingEngine.step()` call
+    (docs/observability.md "Latency attribution").
+
+    ``schedule_s`` is reap/admission bookkeeping net of everything measured
+    elsewhere; ``draft_s`` the drafter proposal; ``dispatch_s`` every jitted
+    call (compile or replay); ``fetch_blocked_s`` the host blocked in
+    ``device_get``; ``deliver_s`` detokenize/retire/SLO accounting net of
+    journal writes; ``journal_s`` journal appends incl. fsync; ``telemetry_s``
+    the telemetry poll. The phases partition ``total_s`` up to clock jitter.
+    """
+
+    schedule_s: float = 0.0
+    draft_s: float = 0.0
+    dispatch_s: float = 0.0
+    fetch_blocked_s: float = 0.0
+    deliver_s: float = 0.0
+    journal_s: float = 0.0
+    telemetry_s: float = 0.0
+    total_s: float = 0.0
+
+    def reset(self) -> None:
+        for name in _STEP_PHASES:
+            setattr(self, name, 0.0)
+
+    def as_dict(self) -> dict[str, float]:
+        return {name: round(getattr(self, name), 6) for name in _STEP_PHASES}
 
 
 # engine snapshot file format tag (docs/reliability.md "Serving recovery"):
@@ -299,6 +334,7 @@ class ServingEngine:
         tokens_per_sync: int = 1,
         paged_attention: str = "gather",
         speculation: Any = None,
+        anomaly: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -532,9 +568,17 @@ class ServingEngine:
         # `TelemetryExporter`; the default NULL_TELEMETRY keeps the one poll
         # site in `step` a single attribute check — zero-overhead off.
         self.telemetry = telemetry if telemetry is not None else NULL_TELEMETRY
+        # anomaly detection + flight recorder (serving/anomaly.py): one
+        # attribute check per step, NULL_ANOMALY default — zero-overhead off
+        self.anomaly = anomaly if anomaly is not None else NULL_ANOMALY
         # (key, compiled, wall_s) of the most recent jitted dispatch — the
         # compile-vs-replay flag EV_DISPATCH events carry
         self._last_dispatch: tuple[str, bool, float] = ("", False, 0.0)
+        # per-step host phase breakdown (docs/observability.md "Latency
+        # attribution"): reset at each step() entry, folded into the
+        # step_phase_* histograms at step exit
+        self._timings = StepTimings()
+        self._last_step_timings: dict[str, float] = {}
 
         b = self.max_concurrency
         # device state: the slot-pool cache (donated through every step) plus
@@ -761,12 +805,15 @@ class ServingEngine:
             # against. Production cost stays the one active_injector() load.
             injector.dispatch_faults()
         compiled = key not in self._compile_seen
-        if not compiled and not self.tracer.enabled:
-            return fn(*args)
         t0 = time.perf_counter()
+        if not compiled and not self.tracer.enabled:
+            out = fn(*args)
+            self._timings.dispatch_s += time.perf_counter() - t0
+            return out
         with self.tracer.annotation(key):
             out = fn(*args)
         dt = time.perf_counter() - t0
+        self._timings.dispatch_s += dt
         if compiled:
             self._compile_seen.add(key)
             self.metrics.record_compile(key, dt)
@@ -1561,6 +1608,13 @@ class ServingEngine:
                 priv / active if active else float(self._blocks_per_slot))
         return out
 
+    @property
+    def last_step_timings(self) -> dict[str, float]:
+        """Phase breakdown (`StepTimings.as_dict`) of the most recent
+        `step()` call — {} before the first step. Supervisor heartbeats and
+        flight-recorder bundles embed it."""
+        return self._last_step_timings
+
     # ------------------------------------------------------------ engine loop
     def step(self) -> list[RequestOutput]:
         """Admit into free slots, dispatch one decode step for every active
@@ -1568,9 +1622,21 @@ class ServingEngine:
         and return the requests whose completion was OBSERVED during this
         call (at depth > 1 a finish surfaces when its fetch lands, up to
         ``pipeline_depth - 1`` calls after the device produced it)."""
+        tm = self._timings
+        tm.reset()
+        t_start = time.perf_counter()
+        journal = self.journal
+        j_start = journal.append_s if journal is not None else 0.0
         finished: list[RequestOutput] = []
         self._reap_ready(finished)
         self._admit_pending(finished)
+        # schedule = reap/admit bookkeeping wall net of the dispatches,
+        # fetches, delivery, and journal writes the admission path performed
+        # (each already accumulated into its own phase)
+        j_sched = (journal.append_s - j_start) if journal is not None else 0.0
+        tm.schedule_s = max(0.0, (time.perf_counter() - t_start)
+                            - tm.dispatch_s - tm.fetch_blocked_s
+                            - tm.deliver_s - j_sched)
         n_active = self.active_slots
         self.metrics.observe_step(n_active, self.max_concurrency,
                                   self.scheduler.queue_depth)
@@ -1594,7 +1660,10 @@ class ServingEngine:
                 # host drafting happens at dispatch time, from the host's
                 # (possibly pipeline-lagged) view of each slot's tokens —
                 # staleness costs acceptance only, verification is exact
-                step_args += (jnp.asarray(self._propose_drafts()),)
+                t_draft = time.perf_counter()
+                drafts = jnp.asarray(self._propose_drafts())
+                tm.draft_s = time.perf_counter() - t_draft
+                step_args += (drafts,)
             if self.paged:
                 # tables ride as data (not donated): decode reads through
                 # them but only admission/release rewrites them
@@ -1633,10 +1702,19 @@ class ServingEngine:
                 tokens=tokens_attr,
             )
             self._inflight.append(entry)
-            if kind == "spec":
-                self._trace_dispatch(entry, "spec", drafted=self.draft_tokens)
+            if self.tracer.enabled:
+                # the step's host-phase breakdown so far rides the dispatch
+                # event — what explain_request charges this token batch with
+                extra = {"phases": {"schedule_s": round(tm.schedule_s, 6),
+                                    "draft_s": round(tm.draft_s, 6),
+                                    "dispatch_s": round(tm.dispatch_s, 6)}}
             else:
-                self._trace_dispatch(entry, "step")
+                extra = {}
+            if kind == "spec":
+                self._trace_dispatch(entry, "spec", drafted=self.draft_tokens,
+                                     **extra)
+            else:
+                self._trace_dispatch(entry, "step", **extra)
             if (self._probe_fn is not None
                     and self._step_count % self.collective_probe_every == 0):
                 t0 = time.perf_counter()
@@ -1651,7 +1729,16 @@ class ServingEngine:
                 and self._step_count % self.metrics_log_every == 0):
             self.metrics.log_to(self.tracker, step=self._step_count)
         if self.telemetry.enabled:
+            t_tel = time.perf_counter()
             self.telemetry.poll(self)
+            tm.telemetry_s = time.perf_counter() - t_tel
+        tm.journal_s = ((journal.append_s - j_start)
+                        if journal is not None else 0.0)
+        tm.total_s = time.perf_counter() - t_start
+        self.metrics.observe_step_phases(tm)
+        self._last_step_timings = tm.as_dict()
+        if self.anomaly.enabled:
+            self.anomaly.observe(self)
         return finished
 
     def run(self, requests: Iterable[Request], max_steps: int | None = None
@@ -2113,17 +2200,14 @@ class ServingEngine:
 
     def _process_oldest(self, finished: list[RequestOutput]) -> None:
         entry = self._inflight.popleft()
+        tm = self._timings
+        journal = self.journal
         blocked_t = time.perf_counter()
         fetched = jax.device_get(entry.arrays)
         blocked = time.perf_counter() - blocked_t
+        tm.fetch_blocked_s += blocked
         self.metrics.host_blocked_s.observe(blocked)
-        if self.tracer.enabled:
-            extra = ({"accepted": int(np.max(fetched[3]))}
-                     if entry.kind == "spec" else {})
-            self.tracer.emit(EV_FETCH, None, seq=entry.seq, what=entry.kind,
-                             blocked_s=round(blocked, 6),
-                             depth=len(self._inflight), tokens=entry.tokens,
-                             **extra)
+        j0 = journal.append_s if journal is not None else 0.0
         now = time.perf_counter()
         if entry.kind == "admit":
             self._process_admit(entry, fetched, now, finished)
@@ -2131,6 +2215,22 @@ class ServingEngine:
             self._process_spec(entry, fetched, now, finished)
         else:
             self._process_step(entry, fetched, now, finished)
+        t_done = time.perf_counter()
+        j1 = journal.append_s if journal is not None else 0.0
+        deliver = max(0.0, (t_done - now) - (j1 - j0))
+        tm.deliver_s += deliver
+        if self.tracer.enabled:
+            # emitted after delivery so the fetch event can attribute its own
+            # host cost; consumers key on seq, not event order
+            extra = ({"accepted": int(np.max(fetched[3]))}
+                     if entry.kind == "spec" else {})
+            self.tracer.emit(EV_FETCH, None, seq=entry.seq, what=entry.kind,
+                             blocked_s=round(blocked, 6),
+                             depth=len(self._inflight), tokens=entry.tokens,
+                             phases={"blocked_s": round(blocked, 6),
+                                     "deliver_s": round(deliver, 6),
+                                     "journal_s": round(j1 - j0, 6)},
+                             **extra)
 
     def _process_admit(self, entry: _Inflight, fetched: tuple, now: float,
                        finished: list[RequestOutput]) -> None:

@@ -160,6 +160,10 @@ class RequestJournal:
         self.progress_every = max(1, int(progress_every))
         self.metrics = metrics
         self.bytes_written = 0
+        # cumulative host wall seconds spent inside `_append` (serialize +
+        # write + flush + fsync) — the engine differences this across a step
+        # to attribute journal time (StepTimings.journal_s)
+        self.append_s = 0.0
         self.compact_threshold_bytes = (
             None if compact_threshold_bytes is None
             else max(len(MAGIC) + 1, int(compact_threshold_bytes)))
@@ -181,8 +185,16 @@ class RequestJournal:
             os.fsync(self._f.fileno())
         self._size = self.path.stat().st_size if existing else len(MAGIC)
 
+    @property
+    def tail_offset(self) -> int:
+        """Byte offset of the append frontier — the file size after the last
+        complete frame. A flight-recorder bundle records it so a forensic
+        `scan` can be correlated with the moment the bundle was cut."""
+        return self._size
+
     # ------------------------------------------------------------- appending
     def _append(self, rec: dict[str, Any]) -> None:
+        t0 = time.perf_counter()
         rec.setdefault("ts", time.time())
         payload = json.dumps(rec, separators=(",", ":")).encode()
         frame = _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
@@ -192,6 +204,7 @@ class RequestJournal:
             self.fsync == FSYNC_ACCEPT and rec["t"] in _DURABLE_TYPES
         ):
             os.fsync(self._f.fileno())
+        self.append_s += time.perf_counter() - t0
         self.bytes_written += len(frame)
         self._size += len(frame)
         if self.metrics is not None:

@@ -8,6 +8,7 @@ Everything here is host-side bookkeeping; nothing touches the device path.
 
 from __future__ import annotations
 
+import bisect
 import math
 import time
 from typing import Any
@@ -30,7 +31,15 @@ class Counter:
 class Histogram:
     """Streaming histogram: exact count/sum/min/max plus a bounded,
     deterministically-strided sample reservoir for quantiles (no RNG — a
-    metrics read must never perturb per-request seeding)."""
+    metrics read must never perturb per-request seeding), plus exact counts
+    over a fixed log-spaced bucket ladder so the Prometheus export can emit
+    real cumulative ``le`` series (``_bucket``/``_sum``/``_count``)."""
+
+    # 1-2-5 per decade, 1e-4 .. 5e4: spans sub-millisecond ITL gaps through
+    # queue depths in the tens of thousands. One shared ladder keeps
+    # cross-replica bucket counts addable key-by-key.
+    BUCKETS: tuple[float, ...] = tuple(
+        m * (10.0 ** e) for e in range(-4, 5) for m in (1.0, 2.0, 5.0))
 
     def __init__(self, max_samples: int = 4096):
         self.count = 0
@@ -40,6 +49,7 @@ class Histogram:
         self._max_samples = int(max_samples)
         self._stride = 1
         self._samples: list[float] = []
+        self._bucket_counts = [0] * len(self.BUCKETS)
 
     @property
     def min(self) -> float:
@@ -57,6 +67,11 @@ class Histogram:
         self.sum += value
         self._min = min(self._min, value)
         self._max = max(self._max, value)
+        i = bisect.bisect_left(self.BUCKETS, value)
+        if i < len(self._bucket_counts):
+            self._bucket_counts[i] += 1
+        # values past the last boundary land only in the implicit +Inf
+        # bucket, whose cumulative count is `count` itself
         if self.count % self._stride == 0:
             self._samples.append(value)
             if len(self._samples) > self._max_samples:
@@ -68,6 +83,19 @@ class Histogram:
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs, Prometheus classic-histogram
+        semantics (count of observations ``<= le``). Boundaries whose
+        cumulative count is still zero are omitted — absent key means zero,
+        which keeps cross-replica aggregation a plain key-wise sum."""
+        out: list[tuple[float, int]] = []
+        cum = 0
+        for le, n in zip(self.BUCKETS, self._bucket_counts):
+            cum += n
+            if cum:
+                out.append((le, cum))
+        return out
 
     def quantile(self, q: float) -> float:
         """Nearest-rank quantile: ``ordered[ceil(q*n) - 1]`` (inverse CDF).
@@ -211,6 +239,21 @@ class ServingMetrics:
         self.spec_forwards = Counter()
         self.spec_tokens = Counter()
         self.spec_accept_len = Histogram()
+        # step-phase attribution (docs/observability.md "Latency
+        # attribution"): host wall seconds of each named phase of ONE
+        # `ServingEngine.step()` call — scheduling/admission bookkeeping,
+        # drafter proposal, jitted dispatch, device-blocked fetch
+        # (`device_get`), detokenize/delivery, journal appends+fsync, and
+        # telemetry export — plus the whole-step wall. One observation per
+        # step; the per-step dict rides EV_DISPATCH/EV_FETCH as ``phases``.
+        self.step_phase_schedule_s = Histogram()
+        self.step_phase_draft_s = Histogram()
+        self.step_phase_dispatch_s = Histogram()
+        self.step_phase_fetch_blocked_s = Histogram()
+        self.step_phase_deliver_s = Histogram()
+        self.step_phase_journal_s = Histogram()
+        self.step_phase_telemetry_s = Histogram()
+        self.step_total_s = Histogram()
         self._start: float | None = None
         # rate window: tokens_per_sec()/goodput() measure from the later of
         # mark_start() and the last reset_rate_window(), so an engine that
@@ -303,6 +346,18 @@ class ServingMetrics:
         for active in active_per_replica:
             self.replica_occupancy.observe(active / capacity if capacity else 0.0)
 
+    def observe_step_phases(self, t: Any) -> None:
+        """Record one step's phase breakdown (a `StepTimings`, or any object
+        with the phase attributes) into the per-phase histograms."""
+        self.step_phase_schedule_s.observe(t.schedule_s)
+        self.step_phase_draft_s.observe(t.draft_s)
+        self.step_phase_dispatch_s.observe(t.dispatch_s)
+        self.step_phase_fetch_blocked_s.observe(t.fetch_blocked_s)
+        self.step_phase_deliver_s.observe(t.deliver_s)
+        self.step_phase_journal_s.observe(t.journal_s)
+        self.step_phase_telemetry_s.observe(t.telemetry_s)
+        self.step_total_s.observe(t.total_s)
+
     def record_compile(self, key: str, seconds: float) -> None:
         """First dispatch of a jitted serving program: one compile, keyed by
         ``kind[pb{prompt_bucket}b{batch_bucket}]@mesh{data}x{model}``."""
@@ -389,9 +444,25 @@ class ServingMetrics:
             ("admit_batch_size", self.admit_batch_size),
             ("tokens_per_dispatch", self.tokens_per_dispatch),
             ("spec_accept_len", self.spec_accept_len),
+            ("step_phase_schedule_s", self.step_phase_schedule_s),
+            ("step_phase_draft_s", self.step_phase_draft_s),
+            ("step_phase_dispatch_s", self.step_phase_dispatch_s),
+            ("step_phase_fetch_blocked_s", self.step_phase_fetch_blocked_s),
+            ("step_phase_deliver_s", self.step_phase_deliver_s),
+            ("step_phase_journal_s", self.step_phase_journal_s),
+            ("step_phase_telemetry_s", self.step_phase_telemetry_s),
+            ("step_total_s", self.step_total_s),
         ):
             for stat, value in hist.summary().items():
                 out[f"serving/{name}/{stat}"] = value
+            if hist.count:
+                # exact series for the Prometheus histogram exposition:
+                # `<base>/sum` plus cumulative `<base>/bucket/<le>` counts
+                # (absent bucket key == cumulative zero, so replica snapshots
+                # aggregate by plain summation)
+                out[f"serving/{name}/sum"] = hist.sum
+                for le, cum in hist.buckets():
+                    out[f"serving/{name}/bucket/{le:g}"] = cum
         return out
 
     def log_to(self, tracker: Any, step: int | None = None) -> None:

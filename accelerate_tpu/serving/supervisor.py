@@ -280,6 +280,11 @@ class EngineSupervisor:
             "restarts": self._budget.used,
             "restarts_remaining": self._budget.max_restarts - self._budget.used,
             "brownout_level": self._brownout_level,
+            # the engine's most recent StepTimings.as_dict() ({} before the
+            # first step / on engines predating phase timing) — the per-phase
+            # view of the same wall time ``last_step_s`` totals
+            "step_phases": dict(
+                getattr(self._engine, "last_step_timings", None) or {}),
         }
 
     def submit(self, request: Request | Any,

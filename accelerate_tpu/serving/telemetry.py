@@ -100,13 +100,37 @@ _REPLICA_PREFIX = re.compile(r"^replica(\d+)/(.+)$")
 
 
 def to_prometheus_text(values: dict) -> str:
-    """One gauge per numeric entry in text-exposition format. Strings and
-    non-finite floats are dropped — a scrape must never see ``nan``/``inf``
-    literals. A ``replica<i>/``-prefixed key (the cluster's per-replica
-    namespace) renders as the unprefixed metric name with a
-    ``{replica="i"}`` label; every metric name gets exactly one ``# TYPE``
-    line however many labeled samples share it."""
-    by_name: dict[str, list[tuple[str, Any]]] = {}
+    """Text-exposition render. Strings and non-finite floats are dropped — a
+    scrape must never see ``nan``/``inf`` literals. A ``replica<i>/``-prefixed
+    key (the cluster's per-replica namespace) renders as the unprefixed
+    metric name with a ``{replica="i"}`` label; every metric name gets
+    exactly one ``# TYPE`` line however many labeled samples share it.
+
+    A key family carrying cumulative ``<base>/bucket/<le>`` entries (what
+    `ServingMetrics.snapshot` emits per `metrics.Histogram`) renders as a
+    REAL Prometheus histogram — ``_bucket{le="..."}`` series in ascending
+    ``le`` order, the implicit ``le="+Inf"`` bucket equal to the count, and
+    ``_sum``/``_count`` — instead of point gauges, so quantiles are
+    computable downstream (``histogram_quantile``). The family consumes the
+    flat ``<base>/sum`` and ``<base>/count`` keys (their sample lines would
+    otherwise collide with the histogram's own); the summary-stat gauges
+    (``<base>/p50`` ...) keep their distinct names and stay gauges."""
+
+    def split(key: str) -> tuple[str, str]:
+        m = _REPLICA_PREFIX.match(key)
+        if m is not None:
+            return f'replica="{m.group(1)}"', m.group(2)
+        return "", key
+
+    hist_bases: set[tuple[str, str]] = set()
+    for key in values:
+        label, rest = split(key)
+        if "/bucket/" in rest:
+            hist_bases.add((label, rest.split("/bucket/", 1)[0]))
+
+    gauges: dict[str, list[tuple[str, Any]]] = {}
+    # family name -> label -> {"buckets": [(le, le_str, v)], "sum": v, "count": v}
+    hists: dict[str, dict[str, dict[str, Any]]] = {}
     for key in values:
         v = values[key]
         if isinstance(v, bool):
@@ -115,20 +139,41 @@ def to_prometheus_text(values: dict) -> str:
             continue
         if isinstance(v, float) and not math.isfinite(v):
             continue
-        m = _REPLICA_PREFIX.match(key)
-        if m is not None:
-            name = prometheus_name(m.group(2))
-            label = f'{{replica="{m.group(1)}"}}'
-        else:
-            name = prometheus_name(key)
-            label = ""
-        by_name.setdefault(name, []).append((label, v))
+        label, rest = split(key)
+        if "/bucket/" in rest:
+            base, le = rest.split("/bucket/", 1)
+            fam = hists.setdefault(prometheus_name(base), {}).setdefault(
+                label, {"buckets": []})
+            fam["buckets"].append((float(le), le, v))
+            continue
+        base, _, stat = rest.rpartition("/")
+        if stat in ("sum", "count") and (label, base) in hist_bases:
+            fam = hists.setdefault(prometheus_name(base), {}).setdefault(
+                label, {"buckets": []})
+            fam[stat] = v
+            continue
+        name = prometheus_name(rest)
+        gauges.setdefault(name, []).append(
+            (f"{{{label}}}" if label else "", v))
     lines: list[str] = []
-    for name in sorted(by_name):
-        lines.append(f"# TYPE {name} gauge")
-        # cluster total (no label) first, then replicas in index order
-        for label, v in sorted(by_name[name]):
-            lines.append(f"{name}{label} {v!r}")
+    for name in sorted(set(gauges) | set(hists)):
+        if name in hists:
+            lines.append(f"# TYPE {name} histogram")
+            # cluster total (no label) first, then replicas in index order
+            for label in sorted(hists[name]):
+                fam = hists[name][label]
+                pre = f"{label}," if label else ""
+                for _, le, v in sorted(fam["buckets"]):
+                    lines.append(f'{name}_bucket{{{pre}le="{le}"}} {v!r}')
+                count = fam.get("count", 0)
+                lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {count!r}')
+                lab = f"{{{label}}}" if label else ""
+                lines.append(f"{name}_sum{lab} {fam.get('sum', 0.0)!r}")
+                lines.append(f"{name}_count{lab} {count!r}")
+        if name in gauges:
+            lines.append(f"# TYPE {name} gauge")
+            for label, v in sorted(gauges[name]):
+                lines.append(f"{name}{label} {v!r}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -245,6 +290,12 @@ class TelemetryExporter:
         if head is not None:
             for k, v in head().items():
                 gauges[f"serving/headroom/{k}"] = v
+        # anomaly monitor (serving/anomaly.py): active-detector count, event/
+        # bundle counters, last-event age, and the latest bundle path (a
+        # string — JSONL-only; the Prometheus render drops it by design)
+        anomaly = getattr(engine, "anomaly", None)
+        if anomaly is not None and getattr(anomaly, "enabled", False):
+            gauges.update(anomaly.gauges())
         # multi-replica source (`ServingCluster.replica_samples`): each
         # replica's gauges ride the same point under `replica<i>/...`, so
         # per-replica and cluster-total series never collide — in JSONL by

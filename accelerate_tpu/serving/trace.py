@@ -75,6 +75,9 @@ EV_FETCH = "fetch"            # its results were drained to the host (or discard
 EV_STALL = "stall"            # heartbeat went stale past the stall timeout
 EV_RESTART = "restart"        # engine rebuilt + journal-resumed [reason, attempt]
 EV_BROWNOUT = "brownout"      # overload brownout entered/exited [phase, level]
+EV_ANOMALY = "anomaly"        # detector fired/cleared [detector, phase, zscore]
+#                               (serving/anomaly.py — enter may carry ``bundle``,
+#                               the flight-recorder debug-bundle path)
 
 # Cluster edges (serving/cluster.py — docs/serving.md "Multi-replica
 # serving"): ``rid`` is the ENGINE-level id on the replica whose tracer
@@ -89,7 +92,7 @@ TERMINAL_KINDS = frozenset({EV_FINISH, EV_REJECT})
 REQUEST_KINDS = frozenset(
     {EV_SUBMIT, EV_QUEUED, EV_ADMIT, EV_QUARANTINE, EV_FINISH, EV_REJECT}
 )
-SUPERVISOR_KINDS = frozenset({EV_STALL, EV_RESTART, EV_BROWNOUT})
+SUPERVISOR_KINDS = frozenset({EV_STALL, EV_RESTART, EV_BROWNOUT, EV_ANOMALY})
 CLUSTER_KINDS = frozenset({EV_ROUTE, EV_MIGRATE})
 
 
@@ -313,11 +316,28 @@ def validate(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
 
         # supervisor edges: schema + brownout enter/exit alternation
         brownout_active = False
+        anomaly_active: set[str] = set()
         for ev in events:
             if ev.kind == EV_STALL and "elapsed_s" not in ev.data:
                 anomalies.append("stall without elapsed_s")
             elif ev.kind == EV_RESTART and not {"reason", "attempt"} <= set(ev.data):
                 anomalies.append("restart without reason/attempt")
+            elif ev.kind == EV_ANOMALY:
+                # anomaly markers (serving/anomaly.py): per-detector strict
+                # enter/exit alternation, the brownout convention
+                det = ev.data.get("detector")
+                phase = ev.data.get("phase")
+                if det is None or phase not in ("enter", "exit"):
+                    anomalies.append(f"anomaly without detector/phase: "
+                                     f"{ev.data!r}")
+                elif (phase == "enter") == (det in anomaly_active):
+                    state = "active" if det in anomaly_active else "inactive"
+                    anomalies.append(f"anomaly {phase} for {det!r} while "
+                                     f"{state}")
+                elif phase == "enter":
+                    anomaly_active.add(det)
+                else:
+                    anomaly_active.discard(det)
             elif ev.kind == EV_BROWNOUT:
                 phase = ev.data.get("phase")
                 if phase not in ("enter", "exit"):
@@ -517,6 +537,9 @@ def to_chrome(events: list[TraceEvent], *, dropped: int = 0) -> dict[str, Any]:
             label = f"restart:{ev.data.get('reason', '?')}"
         elif ev.kind == EV_BROWNOUT:
             label = f"brownout:{ev.data.get('phase', '?')}"
+        elif ev.kind == EV_ANOMALY:
+            label = (f"anomaly:{ev.data.get('detector', '?')}:"
+                     f"{ev.data.get('phase', '?')}")
         out.append({"ph": "i", "pid": _PID_ENGINE, "tid": 0, "name": label,
                     "cat": "supervisor", "ts": us(ev.ts), "s": "p",
                     "args": dict(ev.data)})

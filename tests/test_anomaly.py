@@ -1,0 +1,344 @@
+"""Anomaly detection + flight recorder (serving/anomaly.py,
+docs/observability.md "Flight recorder"): detector determinism under
+injected clocks, brownout-style hysteresis (no flap), baseline freezing,
+bundle rate-limiting, atomic-write crash safety, and the NULL_* zero-overhead
+default.
+
+Everything here drives the monitor through a host-side engine STUB (real
+`ServingMetrics`, real `Tracer`, fake clocks) — the real-engine integration
+lives in `tools/chaos_serve.py` (hang/storm must cut exactly one bundle) and
+the engine-default check at the bottom of this file.
+"""
+
+import inspect
+import json
+import os
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.anomaly]
+
+from accelerate_tpu.serving import ServingMetrics, Tracer
+from accelerate_tpu.serving.anomaly import (
+    BUNDLE_FORMAT,
+    NULL_ANOMALY,
+    AnomalyConfig,
+    AnomalyMonitor,
+    Detector,
+    NullAnomalyMonitor,
+    _atomic_write_json,
+)
+from accelerate_tpu.serving.trace import EV_ANOMALY, validate
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class StubScheduler:
+    queue_depth = 0
+
+    def snapshot_queue(self):
+        return []
+
+
+class StubEngine:
+    """The attribute surface `AnomalyMonitor.observe`/`_collect` touches,
+    with none of the device machinery."""
+
+    def __init__(self, tracer=None):
+        self.metrics = ServingMetrics()
+        self.scheduler = StubScheduler()
+        self.tracer = tracer
+        self.journal = None
+        self._step_count = 0
+        self.last_step_timings = {"total_s": 0.001}
+
+    def memory_stats(self):
+        return {"slots_total": 4, "slots_active": 1}
+
+    def capacity_headroom(self):
+        return {"admissible_requests": 3}
+
+
+def _cfg(**kw):
+    base = dict(window=32, min_samples=4, zscore=6.0, enter_steps=2,
+                exit_steps=3, exit_fraction=0.5)
+    base.update(kw)
+    return AnomalyConfig(**base)
+
+
+def _feed(det, values):
+    return [(i, edge) for i, v in enumerate(values)
+            if (edge := det.update(v)) is not None]
+
+
+# ------------------------------------------------------------- determinism
+def test_detector_deterministic():
+    """Same sample sequence -> identical edge sequence, twice. No wall-clock
+    read sits anywhere in the decision path."""
+    values = [1.0, 1.1, 0.9, 1.0, 1.05, 9.0, 9.5, 9.0, 1.0, 1.0, 1.0, 1.0]
+    edges_a = _feed(Detector("itl", "high", _cfg()), values)
+    edges_b = _feed(Detector("itl", "high", _cfg()), values)
+    assert edges_a == edges_b
+    assert [e for _, e in edges_a] == ["enter", "exit"]
+    # enter only after enter_steps=2 consecutive out-of-band samples
+    assert edges_a[0][0] == 6
+
+
+def test_monitor_deterministic_under_injected_clock():
+    clocks = FakeClock(), FakeClock()
+    runs = []
+    for clk in clocks:
+        mon = AnomalyMonitor(_cfg(enter_steps=1, exit_steps=2),
+                             clock=clk, wall_clock=clk)
+        eng = StubEngine()
+        edges = []
+        for v in [0.01, 0.011, 0.009, 0.01, 5.0, 0.01, 0.01]:
+            info = mon.ingest("custom_signal", v, eng)
+            if info is not None:
+                edges.append((info["detector"], info["phase"]))
+            clk.t += 1.0
+        runs.append((edges, mon.events,
+                     {k: v for k, v in mon.gauges().items()
+                      if k != "anomaly/last_event_age_s"}))
+    assert runs[0] == runs[1]
+    assert runs[0][0] == [("custom_signal", "enter"), ("custom_signal", "exit")]
+
+
+# -------------------------------------------------------------- hysteresis
+def test_short_spike_does_not_arm():
+    det = Detector("itl", "high", _cfg(enter_steps=3))
+    assert _feed(det, [1.0] * 8 + [50.0, 50.0] + [1.0] * 8) == []
+    assert not det.active
+
+
+def test_hysteresis_no_flap_around_threshold():
+    """Once active, samples oscillating between 'still bad' and 'barely
+    calm' never exit: exit needs exit_steps CONSECUTIVE calm samples."""
+    det = Detector("itl", "high", _cfg(enter_steps=1, exit_steps=3))
+    for v in [1.0] * 8:
+        det.update(v)
+    assert det.update(50.0) == "enter"
+    flapping = [1.0, 50.0, 1.0, 50.0, 1.0, 50.0, 1.0, 50.0]
+    assert _feed(det, flapping) == []
+    assert det.active and det.trips == 1
+    # three consecutive calm samples finally disarm, exactly once
+    assert _feed(det, [1.0, 1.0, 1.0]) == [(2, "exit")]
+    assert not det.active
+
+
+def test_baseline_frozen_while_active():
+    """A long anomaly must not become the new normal: anomalous samples
+    never enter the baseline window, so recovery to the OLD baseline still
+    exits and a repeat anomaly still scores anomalous."""
+    det = Detector("itl", "high", _cfg(enter_steps=1, exit_steps=2))
+    for v in [1.0] * 8:
+        det.update(v)
+    baseline = sorted(det.window)
+    assert det.update(100.0) == "enter"
+    for v in [100.0] * 50:  # an hour of elevated signal
+        det.update(v)
+    assert sorted(det.window) == baseline  # frozen
+    assert _feed(det, [1.0, 1.0]) == [(1, "exit")]
+    # the baseline never learned 100.0 as normal, so a repeat anomaly
+    # scores anomalous again immediately (enter_steps=1)
+    assert det.update(100.0) == "enter"
+
+
+def test_direction_low_fires_on_collapse():
+    det = Detector("blocks_free", "low", _cfg(enter_steps=1))
+    for v in [40.0, 41.0, 39.0, 40.0, 40.0]:
+        det.update(v)
+    assert det.update(0.0) == "enter"
+
+
+def test_floor_suppresses_trivial_queue_depth():
+    """queue 0 -> 3 is statistically wild (MAD 0) but operationally nothing:
+    the floor gates high-direction triggers on absolute value."""
+    det = Detector("queue_depth", "high", _cfg(enter_steps=1), floor=4.0)
+    for v in [0.0] * 8:
+        det.update(v)
+    assert det.update(3.0) is None
+    assert not det.active
+    assert det.update(50.0) == "enter"  # past the floor: genuine
+
+
+# ---------------------------------------------------------- trace markers
+def test_enter_exit_markers_validate():
+    tracer = Tracer()
+    mon = AnomalyMonitor(_cfg(enter_steps=1, exit_steps=1))
+    eng = StubEngine(tracer=tracer)
+    for v in [1.0] * 6 + [99.0, 1.0]:
+        mon.ingest("itl_p99_s", v, eng)
+    kinds = [(ev.data["detector"], ev.data["phase"]) for ev in tracer.events()
+             if ev.kind == EV_ANOMALY]
+    assert kinds == [("itl_p99_s", "enter"), ("itl_p99_s", "exit")]
+    assert validate(tracer.events())["clean"]
+
+
+# --------------------------------------------------------- flight recorder
+def _bundle_monitor(tmp_path, clk, **cfg_kw):
+    cfg = _cfg(enter_steps=1, exit_steps=1, bundle_dir=str(tmp_path),
+               bundle_min_interval_s=60.0, **cfg_kw)
+    return AnomalyMonitor(cfg, clock=clk, wall_clock=clk)
+
+
+def _trip(mon, eng, value=500.0):
+    """One full enter+exit cycle on a warmed-up detector."""
+    enter = mon.ingest("itl_p99_s", value, eng)
+    assert enter is not None and enter["phase"] == "enter"
+    exit_ = mon.ingest("itl_p99_s", 1.0, eng)
+    assert exit_ is not None and exit_["phase"] == "exit"
+    return enter
+
+
+def test_bundle_rate_limit_exactly_one_in_window(tmp_path):
+    clk = FakeClock()
+    mon = _bundle_monitor(tmp_path, clk)
+    eng = StubEngine(tracer=Tracer())
+    for v in [1.0] * 6:
+        mon.ingest("itl_p99_s", v, eng)
+
+    first = _trip(mon, eng)
+    assert first["bundle"] is not None and os.path.exists(first["bundle"])
+    clk.t += 10.0  # inside the 60 s window
+    second = _trip(mon, eng)
+    assert second["bundle"] is None  # rate-limited: first bundle has the evidence
+    assert mon.bundles_written == 1
+    assert len(list(tmp_path.glob("anomaly-*.json"))) == 1
+
+    clk.t += 61.0  # window expired
+    third = _trip(mon, eng)
+    assert third["bundle"] is not None
+    assert mon.bundles_written == 2
+    assert mon.events == 6  # every edge counted, bundles rate-limited
+
+
+def test_bundle_dir_created_on_first_bundle(tmp_path):
+    """A fresh (nonexistent, nested) bundle_dir must not silently become a
+    bundle_error — the monitor creates it on the first write."""
+    clk = FakeClock()
+    mon = _bundle_monitor(tmp_path / "not" / "yet" / "made", clk)
+    eng = StubEngine(tracer=Tracer())
+    for v in [1.0] * 6:
+        mon.ingest("itl_p99_s", v, eng)
+    info = _trip(mon, eng)
+    assert mon.bundle_errors == 0
+    assert info["bundle"] is not None and os.path.exists(info["bundle"])
+
+
+def test_bundle_is_valid_v1_json(tmp_path):
+    clk = FakeClock()
+    mon = _bundle_monitor(tmp_path, clk)
+    tracer = Tracer()
+    tracer.emit("submit", 0, prompt_len=4)
+    eng = StubEngine(tracer=tracer)
+    eng.metrics.inter_token_s.observe(0.01)
+    for v in [1.0] * 6:
+        mon.ingest("itl_p99_s", v, eng)
+    info = _trip(mon, eng)
+
+    with open(info["bundle"]) as f:
+        doc = json.load(f)
+    assert doc["format"] == BUNDLE_FORMAT
+    assert doc["trigger"]["detector"] == "itl_p99_s"
+    assert doc["trigger"]["zscore"] > 6.0
+    assert "itl_p99_s" in doc["active"]
+    assert doc["trace_tail"][0][1] == "submit"  # [ts, kind, rid, data]
+    assert doc["metrics"]["serving/inter_token_s/count"] == 1
+    assert doc["memory_stats"]["slots_total"] == 4
+    assert doc["capacity_headroom"]["admissible_requests"] == 3
+    assert doc["step_timings"] == {"total_s": 0.001}
+    assert doc["queue"] == []
+
+
+def test_bundle_write_failure_is_contained(tmp_path, monkeypatch):
+    """A crash mid-write leaves NO partial bundle (tmp unlinked, no final
+    file), errors are counted, and the monitor keeps serving detectors."""
+    import accelerate_tpu.serving.anomaly as anomaly_mod
+
+    clk = FakeClock()
+    mon = _bundle_monitor(tmp_path, clk)
+    eng = StubEngine()
+    for v in [1.0] * 6:
+        mon.ingest("itl_p99_s", v, eng)
+
+    real_replace = os.replace
+
+    def broken_replace(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(anomaly_mod.os, "replace", broken_replace)
+    info = _trip(mon, eng)
+    assert info["bundle"] is None
+    assert mon.bundle_errors == 1
+    assert list(tmp_path.iterdir()) == []  # no bundle, no torn .tmp
+
+    # recorder recovers once the filesystem does (rate window not consumed
+    # by the failed attempt)
+    monkeypatch.setattr(anomaly_mod.os, "replace", real_replace)
+    info = _trip(mon, eng)
+    assert info["bundle"] is not None
+    assert len(list(tmp_path.glob("anomaly-*.json"))) == 1
+
+
+def test_atomic_write_unlinks_tmp_on_serialize_failure(tmp_path):
+    path = tmp_path / "bundle.json"
+    with pytest.raises(ValueError):
+        _atomic_write_json(path, {"bad": float("nan")})  # allow_nan=False
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------------------ zero-overhead NULL
+def test_null_monitor_is_inert():
+    assert NULL_ANOMALY.enabled is False
+    assert isinstance(NULL_ANOMALY, NullAnomalyMonitor)
+    assert NULL_ANOMALY.observe(object()) == []
+    assert NULL_ANOMALY.ingest("x", 1.0) is None
+    assert NULL_ANOMALY.gauges() == {}
+    assert NULL_ANOMALY.active == [] and NULL_ANOMALY.detectors == {}
+
+
+def test_engine_defaults_to_null_monitor():
+    """`ServingEngine(...)` without `anomaly=` must carry the NULL singleton:
+    the per-step cost of the feature being off is one attribute read
+    (`self.anomaly.enabled`) — the chaos harness and test_serving cover the
+    attached path end-to-end."""
+    from accelerate_tpu.serving import ServingEngine
+
+    sig = inspect.signature(ServingEngine.__init__)
+    assert sig.parameters["anomaly"].default is None
+
+
+def test_observe_every_downsamples():
+    mon = AnomalyMonitor(_cfg(observe_every=4))
+    eng = StubEngine()
+    for _ in range(8):
+        mon.observe(eng)
+    # ticks 4 and 8 sampled: queue_depth + goodput signals = 2 detectors fed
+    assert len(mon.detectors["queue_depth"].window) == 2
+
+
+def test_gauges_shape(tmp_path):
+    clk = FakeClock()
+    mon = _bundle_monitor(tmp_path, clk)
+    eng = StubEngine()
+    for v in [1.0] * 6:
+        mon.ingest("itl_p99_s", v, eng)
+    g0 = mon.gauges()
+    assert g0["anomaly/active"] == 0 and g0["anomaly/events"] == 0
+    assert "anomaly/active_detectors" not in g0
+
+    mon.ingest("itl_p99_s", 500.0, eng)
+    clk.t += 2.5
+    g1 = mon.gauges()
+    assert g1["anomaly/active"] == 1
+    assert g1["anomaly/active_detectors"] == "itl_p99_s"
+    assert g1["anomaly/last_event_age_s"] == pytest.approx(2.5)
+    assert g1["anomaly/bundles"] == 1
+    assert g1["anomaly/last_bundle"] == mon.last_bundle_path

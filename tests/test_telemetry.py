@@ -199,7 +199,10 @@ def test_prometheus_round_trip_from_engine_run(model, tmp_path):
     assert parsed  # not empty
     for name, value in parsed.items():
         assert name.startswith("accelerate_tpu_")
-        assert all(c.isalnum() or c == "_" for c in name)
+        base, _, label = name.partition("{")
+        assert all(c.isalnum() or c == "_" for c in base)
+        if label:  # histogram series carry a {le="..."} label block
+            assert base.endswith("_bucket") and 'le="' in label
         assert math.isfinite(value)
     assert (parsed[prometheus_name("serving/mem/slot_pool_bytes")]
             == tree_nbytes(engine._cache))

@@ -1,6 +1,7 @@
 """End-to-end smoke tests for the repo's file-inspection CLIs —
 `tools/trace_report.py`, `tools/journal_fsck.py`, `tools/bench_gate.py`,
-and `tools/serve_top.py` — run as real subprocesses against generated
+`tools/serve_top.py`, `tools/explain_request.py`, and
+`tools/check_metrics_docs.py` — run as real subprocesses against generated
 fixtures, asserting the exit-code contract each tool documents:
 
     0  the file parsed and is clean
@@ -12,9 +13,13 @@ Exit codes are the scripting interface (CI gates pipe these tools); a drift
 here breaks callers silently, which is why the contract gets its own suite.
 """
 
+import contextlib
+import importlib.util
+import io
 import json
 import subprocess
 import sys
+import types
 from pathlib import Path
 
 import pytest
@@ -34,6 +39,7 @@ from accelerate_tpu.serving.trace import (
     EV_FETCH,
     EV_FINISH,
     EV_QUEUED,
+    EV_STALL,
     EV_SUBMIT,
 )
 
@@ -42,6 +48,8 @@ _TRACE_REPORT = _REPO / "tools" / "trace_report.py"
 _JOURNAL_FSCK = _REPO / "tools" / "journal_fsck.py"
 _BENCH_GATE = _REPO / "tools" / "bench_gate.py"
 _SERVE_TOP = _REPO / "tools" / "serve_top.py"
+_EXPLAIN = _REPO / "tools" / "explain_request.py"
+_DOCS_LINT = _REPO / "tools" / "check_metrics_docs.py"
 
 
 def _run(tool: Path, *args: str) -> subprocess.CompletedProcess:
@@ -49,6 +57,26 @@ def _run(tool: Path, *args: str) -> subprocess.CompletedProcess:
         [sys.executable, str(tool), *map(str, args)],
         capture_output=True, text=True, timeout=120,
     )
+
+
+_TOOL_MODULES: dict[str, types.ModuleType] = {}
+
+
+def _run_inproc(tool: Path, *args: str) -> types.SimpleNamespace:
+    """Same contract as `_run` but calls the tool's `main(argv)` in-process —
+    interpreter startup dominates `_run`, so tests that probe many exit-code
+    branches use this and keep one real-subprocess case per tool."""
+    mod = _TOOL_MODULES.get(str(tool))
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(tool.stem, tool)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _TOOL_MODULES[str(tool)] = mod
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = mod.main([str(a) for a in args])
+    return types.SimpleNamespace(returncode=rc, stdout=out.getvalue(),
+                                 stderr="")
 
 
 def _clean_trace(path: Path) -> None:
@@ -263,3 +291,190 @@ def test_serve_top_exit_2_on_non_telemetry_file(tmp_path):
     assert proc.returncode == 2
     assert json.loads(proc.stdout)["error"]
     assert _run(_SERVE_TOP, tmp_path / "missing.jsonl").returncode == 2
+
+
+@pytest.mark.telemetry
+def test_serve_top_alerts_line(tmp_path):
+    """Anomaly gauges in a point render the alerts line next to health
+    (docs/observability.md "Flight recorder")."""
+    path = tmp_path / "telemetry.jsonl"
+    point = {
+        "_step": 5, "_ts": 1700000000.0,
+        "serving/tokens_per_sec": 50.0,
+        "supervisor/restarts": 1,
+        "anomaly/active": 1, "anomaly/active_detectors": "itl_p99_s",
+        "anomaly/events": 3, "anomaly/bundles": 1,
+        "anomaly/last_event_age_s": 2.5,
+        "anomaly/last_bundle": "/tmp/anomaly-0000-itl_p99_s.json",
+    }
+    path.write_text(json.dumps(point) + "\n")
+    proc = _run(_SERVE_TOP, path)
+    assert proc.returncode == 0, proc.stderr
+    assert "alerts FIRING [itl_p99_s]" in proc.stdout
+    assert "last event 2.5s ago" in proc.stdout
+    assert "bundle /tmp/anomaly-0000-itl_p99_s.json" in proc.stdout
+    # no anomaly gauges -> no alerts line (monitor not attached)
+    del point["anomaly/active"]
+    path.write_text(json.dumps(point) + "\n")
+    assert "alerts" not in _run(_SERVE_TOP, path).stdout
+
+
+# --------------------------------------------------------- explain_request
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _slow_request_trace(path: Path, rid: int = 7) -> dict:
+    """The acceptance fixture: 1 s queue wait + 2 s compile prefill + six
+    10 ms decode tokens with a 3 s supervisor stall in the middle. Returns
+    the ground-truth phase durations."""
+    clk = _FakeClock()
+    t = Tracer(clock=clk)
+    t.emit(EV_SUBMIT, rid, prompt_len=16, slo=None)
+    t.emit(EV_QUEUED, rid, queue_depth=1, bucket=16)
+    clk.t += 1.0  # queue wait
+    s0 = t.next_seq()
+    t.emit(EV_DISPATCH, None, seq=s0, what="admit", key="admit[pb16b1]",
+           compiled=True, dispatch_s=1.8, depth=1, step=0,
+           reqs=((0, rid, 0),))
+    t.emit(EV_ADMIT, rid, slot=0, gen=0, bucket=16, seq=s0, cache_hit=False,
+           cached_tokens=0, resumed=0, depth=1)
+    clk.t += 2.0  # compile prefill
+    t.emit(EV_FETCH, None, seq=s0, what="admit", blocked_s=1.9, depth=0)
+    for i in range(6):
+        if i == 4:
+            clk.t += 3.0  # mid-decode stall
+            t.emit(EV_STALL, None, elapsed_s=3.0, timeout_s=0.15)
+        seq = t.next_seq()
+        t.emit(EV_DISPATCH, None, seq=seq, what="step", key="step@mesh1x1",
+               compiled=False, dispatch_s=0.001, depth=1, step=1 + i,
+               reqs=((0, rid, 0),))
+        clk.t += 0.010
+        t.emit(EV_FETCH, None, seq=seq, what="step", blocked_s=0.009, depth=0)
+    t.emit(EV_FINISH, rid, slot=0, gen=0, reason=FINISH_LENGTH, tokens=7,
+           depth=0)
+    assert t.validate()["clean"]
+    t.export(path)
+    return {"queue_wait": 1.0, "prefill": 2.0, "decode": 3.06,
+            "total": 6.06}
+
+
+def test_explain_request_attributes_slow_request(tmp_path):
+    """The tentpole acceptance: >= 95% of wall time lands in named phases,
+    and the 3 s mid-decode gap is annotated with the overlapping stall."""
+    path = tmp_path / "slow.trace.json"
+    truth = _slow_request_trace(path)
+    proc = _run(_EXPLAIN, "7", path, "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["clean"] and rep["terminal"] == "finish"
+    assert rep["total_s"] == pytest.approx(truth["total"], abs=1e-6)
+    assert rep["coverage"] >= 0.95
+    for phase in ("queue_wait", "prefill", "decode"):
+        assert rep["phase_totals"][phase] == pytest.approx(
+            truth[phase], abs=1e-6), phase
+    prefill = next(s for s in rep["segments"] if s["phase"] == "prefill")
+    assert prefill["compiled"] is True and prefill["dispatch_s"] == 1.8
+    worst = rep["slowest_gaps"][0]
+    assert worst["gap_s"] == pytest.approx(3.01, abs=1e-6)
+    assert any("stall" in note for note in worst["overlaps"])
+    # human-readable mode carries the same story
+    proc = _run(_EXPLAIN, "7", path)
+    assert proc.returncode == 0
+    assert "queue_wait" in proc.stdout and "stall" in proc.stdout
+
+
+def test_explain_request_single_vs_merged_consistent(tmp_path):
+    """`r0:<rid>` against [trace0, trace1] must attribute identically to
+    `<rid>` against trace0 alone — replica id spaces never mix."""
+    p0 = tmp_path / "r0.trace.json"
+    p1 = tmp_path / "r1.trace.json"
+    _slow_request_trace(p0, rid=7)
+    _slow_request_trace(p1, rid=3)
+    single = _run_inproc(_EXPLAIN, "7", p0, "--json")
+    merged = _run_inproc(_EXPLAIN, "r0:7", p0, p1, "--json")
+    assert single.returncode == 0 and merged.returncode == 0
+    a, b = json.loads(single.stdout), json.loads(merged.stdout)
+    for key in ("segments", "phase_totals", "coverage", "total_s", "gaps",
+                "slowest_gaps", "tokens", "terminal"):
+        assert a[key] == b[key], key
+    # r1's id space: rid 3 lives in trace1, not trace0
+    assert _run_inproc(_EXPLAIN, "r1:3", p0, p1, "--json").returncode == 0
+    assert _run_inproc(_EXPLAIN, "3", p0).returncode == 2
+
+
+def test_explain_request_exit_contract(tmp_path):
+    path = tmp_path / "clean.trace.json"
+    _clean_trace(path)
+    assert _run_inproc(_EXPLAIN, "0", path).returncode == 0
+    # rid found but stream has no terminal -> 1
+    t = Tracer()
+    t.emit(EV_SUBMIT, 5, prompt_len=4)
+    t.emit(EV_QUEUED, 5, queue_depth=1, bucket=8)
+    torn = tmp_path / "torn.trace.json"
+    t.export(torn)
+    assert _run_inproc(_EXPLAIN, "5", torn).returncode == 1
+    # unknown rid / not a trace / missing file -> 2, JSON error on stdout
+    proc = _run_inproc(_EXPLAIN, "42", path)
+    assert proc.returncode == 2
+    assert "not found" in json.loads(proc.stdout)["error"]
+    garbage = tmp_path / "garbage.bin"
+    garbage.write_bytes(b"\x00 nope")
+    assert _run_inproc(_EXPLAIN, "0", garbage).returncode == 2
+    assert _run_inproc(_EXPLAIN, "0",
+                       tmp_path / "missing.json").returncode == 2
+    # replica index out of range -> 2
+    assert _run_inproc(_EXPLAIN, "r3:0", path).returncode == 2
+
+
+def test_explain_request_journal_and_telemetry_context(tmp_path):
+    path = tmp_path / "clean.trace.json"
+    _clean_trace(path)
+    jpath = tmp_path / "requests.journal"
+    with RequestJournal(jpath) as j:
+        j.log_submit(Request([1, 2, 3, 4], SamplingParams(max_new_tokens=4),
+                             request_id=0))
+        j.log_first_token(0, 7, 1)
+        j.log_finish(0, FINISH_LENGTH, [7, 8])
+    tpath = tmp_path / "telemetry.jsonl"
+    tpath.write_text(json.dumps({
+        "_step": 3, "_ts": 1700000000.0,
+        "serving/inter_token_s/p99": 0.012, "anomaly/active": 0}) + "\n")
+    proc = _run_inproc(_EXPLAIN, "0", path, "--journal", jpath,
+                       "--telemetry", tpath, "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["journal"]["present"] and rep["journal"]["finished"]
+    assert rep["journal"]["finish_reason"] == FINISH_LENGTH
+    assert rep["telemetry"]["points"] == 1
+    assert rep["telemetry"]["last"]["serving/inter_token_s/p99"] == 0.012
+
+
+# ------------------------------------------------------ check_metrics_docs
+def test_check_metrics_docs_clean_on_repo_docs():
+    """The shipped docs must cover the shipped surface — this IS the drift
+    gate: adding a metric or event kind without documenting it fails here."""
+    proc = _run(_DOCS_LINT, "--json")
+    assert proc.returncode == 0, proc.stdout
+    rep = json.loads(proc.stdout)
+    assert rep["clean"] and not rep["missing_metrics"]
+    assert rep["families"] > 50 and rep["kinds"] >= 14
+
+
+def test_check_metrics_docs_detects_drift(tmp_path):
+    """Strip one documented family from the doc -> exit 1 naming it."""
+    doc = (_REPO / "docs" / "observability.md").read_text()
+    assert "serving/ttft_s" in doc
+    stripped = tmp_path / "observability.md"
+    stripped.write_text(doc.replace("serving/ttft_s", "serving/ttft_RENAMED"))
+    proc = _run_inproc(_DOCS_LINT, "--doc", stripped, "--json")
+    assert proc.returncode == 1, proc.stdout
+    rep = json.loads(proc.stdout)
+    assert "serving/ttft_s" in rep["missing_metrics"]
+    # unreadable doc -> 2
+    assert _run_inproc(_DOCS_LINT, "--doc",
+                       tmp_path / "missing.md").returncode == 2

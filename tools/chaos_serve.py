@@ -441,6 +441,8 @@ def run_supervised(
         FINISH_EOS,
         FINISH_LENGTH,
         REJECT_UNHEALTHY,
+        AnomalyConfig,
+        AnomalyMonitor,
         EngineSupervisor,
         Request,
         ServingEngine,
@@ -452,6 +454,16 @@ def run_supervised(
         raise ValueError(f"unknown supervised scenario {scenario!r}")
     workdir = workdir or tempfile.mkdtemp(prefix="chaos_supervised_")
     journal = os.path.join(workdir, "requests.journal")
+    # flight recorder (docs/observability.md): chaos-tuned detectors — tiny
+    # baseline + single-step entry, so the injected fault's latency spike
+    # must cut exactly one debug bundle inside the rate-limit window
+    bundle_dir = os.path.join(workdir, "anomaly")
+    os.makedirs(bundle_dir, exist_ok=True)
+    monitor = AnomalyMonitor(AnomalyConfig(
+        min_samples=4, zscore=4.0, enter_steps=1, exit_steps=4,
+        bundle_dir=bundle_dir, bundle_min_interval_s=60.0))
+    # the trace doubles as explain_request's input, so always record one
+    trace_path = trace_path or os.path.join(workdir, "chaos.trace.json")
     cfg = GPT2Config.tiny(dtype=jnp.float32)
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0))
@@ -478,16 +490,18 @@ def run_supervised(
         sup_cfg = SupervisorConfig(storm_quarantines=2, storm_window_steps=8,
                                    max_restarts=max_restarts)
     injector = FaultInjector(seed=seed, specs=specs)
-    tracer = Tracer() if trace_path else None
+    tracer = Tracer()
 
     def factory(**kw):
         # the SAME module/params objects on every rebuild: the restarted
         # engine's jitted programs come from the process-level shared-jit
-        # cache, so recovery skips recompilation
+        # cache, so recovery skips recompilation. The anomaly monitor is
+        # closed in HERE (the supervisor only forwards journal/metrics/
+        # tracer) so its detector state survives every rebuild.
         return ServingEngine(
             module, params, max_concurrency=concurrency,
             prompt_buckets=BUCKETS, max_queue=n_requests + 1,
-            pipeline_depth=pipeline_depth, **kw,
+            pipeline_depth=pipeline_depth, anomaly=monitor, **kw,
         )
 
     sup = EngineSupervisor(factory, journal, config=sup_cfg, tracer=tracer)
@@ -564,6 +578,40 @@ def run_supervised(
         trace_summary = {"path": exported["path"],
                          "events": exported["events"],
                          "dropped": exported["dropped"]}
+
+    bundles: list[str] = []
+    if not failed_fast:
+        # the injected fault's latency spike must have tripped the flight
+        # recorder: at least one bundle, valid JSON in the v1 schema, no
+        # torn tmp files (atomic-write contract), and `explain_request`
+        # must attribute a recovered request's wall time clean (exit 0)
+        import glob as _glob
+        import subprocess
+
+        from accelerate_tpu.serving.anomaly import BUNDLE_FORMAT
+
+        bundles = sorted(_glob.glob(os.path.join(bundle_dir, "anomaly-*.json")))
+        assert bundles, (f"no debug bundle under the {scenario} scenario "
+                         f"(events={monitor.events})")
+        with open(bundles[0]) as f:
+            doc = json.load(f)
+        assert doc.get("format") == BUNDLE_FORMAT, doc.get("format")
+        assert doc["trigger"]["detector"] in monitor.detectors, doc["trigger"]
+        assert not _glob.glob(os.path.join(bundle_dir, "*.tmp")), \
+            "torn bundle tmp file left behind"
+        clean = sorted(rid for rid, reason in terminal.items()
+                       if reason in (FINISH_EOS, FINISH_LENGTH))
+        assert clean, f"no cleanly finished request to explain: {reasons}"
+        explain = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "explain_request.py"),
+             str(clean[0]), trace_path, "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert explain.returncode == 0, \
+            (f"explain_request rid={clean[0]} exited "
+             f"{explain.returncode}: {explain.stdout[-500:]}"
+             f"{explain.stderr[-500:]}")
     sup.close()
     return {
         "metric": "chaos_serve_supervised_lost_requests",
@@ -589,6 +637,9 @@ def run_supervised(
             "parity_checked": checked,
             "parity_drift": len(drift),
             "trace": trace_summary,
+            "anomaly_events": monitor.events,
+            "anomaly_bundles": bundles,
+            "anomaly_bundle_errors": monitor.bundle_errors,
             "wall_s": round(time.perf_counter() - t0, 3),
         },
     }

@@ -1,0 +1,127 @@
+"""Lint `docs/observability.md` against the code's actual metric and trace
+surface — the docs-drift gate (tier-1 via `tests/test_tools_cli.py`).
+
+Two one-way checks, code -> docs:
+
+  - every metric FAMILY a fresh engine can export must be named in the doc.
+    Families come from a live ``ServingMetrics().snapshot()`` plus a fresh
+    ``AnomalyMonitor().gauges()``, with summary-stat suffixes stripped
+    (``serving/ttft_s/p99`` -> ``serving/ttft_s``); the per-SLO-class and
+    per-compile-key families are dynamic (request-dependent key tails) and
+    are checked as their prefixes;
+  - every trace event KIND (each ``EV_*`` constant in `serving/trace.py`)
+    must appear in the doc as a code span (`` `kind` `` — the event schema
+    table).
+
+The check is deliberately NOT docs -> code: prose may discuss retired or
+planned names. Adding a metric or event without documenting it fails tier-1;
+that is the point.
+
+Exit status: 0 = docs cover the surface; 1 = drift (each missing name
+printed); 2 = doc unreadable / surface import failed.
+
+Run:
+    python tools/check_metrics_docs.py [--doc docs/observability.md] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_STAT_SUFFIXES = frozenset(
+    {"count", "mean", "min", "max", "p50", "p90", "p99", "sum"})
+# families whose key tails are request-dependent (SLO class names, compile
+# cache keys): documented as a prefix, not per-member
+_DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/")
+_DEFAULT_DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "observability.md")
+
+
+def metric_families() -> list[str]:
+    """Every family name a snapshot/telemetry export can produce, suffixes
+    stripped and dynamic tails reduced to their documented prefix."""
+    from accelerate_tpu.serving.anomaly import AnomalyMonitor
+    from accelerate_tpu.serving.metrics import ServingMetrics
+
+    keys = set(ServingMetrics().snapshot())
+    keys |= set(AnomalyMonitor().gauges())
+    families = set()
+    for key in keys:
+        dyn = next((p for p in _DYNAMIC_PREFIXES if key.startswith(p)), None)
+        if dyn is not None:
+            families.add(dyn.rstrip("/"))
+            continue
+        parts = key.split("/")
+        if len(parts) > 2 and parts[-1] in _STAT_SUFFIXES:
+            parts = parts[:-1]
+        elif "bucket" in parts:
+            parts = parts[:parts.index("bucket")]
+        families.add("/".join(parts))
+    return sorted(families)
+
+
+def trace_kinds() -> list[str]:
+    """Every EV_* kind string `serving/trace.py` defines."""
+    from accelerate_tpu.serving import trace as trace_mod
+
+    return sorted({value for name, value in vars(trace_mod).items()
+                   if name.startswith("EV_") and isinstance(value, str)})
+
+
+def check(doc_path: str) -> dict:
+    """Importable core: ``{"doc", "families", "kinds", "missing_metrics",
+    "missing_kinds", "clean"}``. Raises ``OSError`` on an unreadable doc."""
+    with open(doc_path) as f:
+        text = f.read()
+    families = metric_families()
+    kinds = trace_kinds()
+    missing_metrics = [fam for fam in families if fam not in text]
+    missing_kinds = [k for k in kinds if f"`{k}`" not in text]
+    return {
+        "doc": str(doc_path),
+        "families": len(families),
+        "kinds": len(kinds),
+        "missing_metrics": missing_metrics,
+        "missing_kinds": missing_kinds,
+        "clean": not missing_metrics and not missing_kinds,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--doc", default=_DEFAULT_DOC,
+                        help="doc to lint (default docs/observability.md)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the report as one JSON document")
+    args = parser.parse_args(argv)
+    try:
+        rep = check(args.doc)
+    except (OSError, ValueError, ImportError) as exc:
+        print(json.dumps({"doc": args.doc, "error": str(exc)}), flush=True)
+        return 2
+    if args.json:
+        print(json.dumps(rep), flush=True)
+    else:
+        print(f"{rep['doc']}: {rep['families']} metric families, "
+              f"{rep['kinds']} trace kinds")
+        for fam in rep["missing_metrics"]:
+            print(f"  MISSING metric family: {fam}")
+        for kind in rep["missing_kinds"]:
+            print(f"  MISSING trace kind (as `{kind}`)")
+        print("clean" if rep["clean"] else
+              f"DRIFT: {len(rep['missing_metrics'])} metric(s), "
+              f"{len(rep['missing_kinds'])} kind(s) undocumented")
+    return 0 if rep["clean"] else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(0)

@@ -179,6 +179,20 @@ def render(point: dict, history: list[dict] | None = None,
             f"shed {g('supervisor/shed_requests', 0)}, "
             f"brownout {brownout}")
 
+    # anomaly gauges appear only when an AnomalyMonitor is attached
+    # (serving/anomaly.py — docs/observability.md "Flight recorder")
+    if g("anomaly/active") is not None:
+        active = int(g("anomaly/active", 0))
+        detectors = g("anomaly/active_detectors", "")
+        state = (f"FIRING [{detectors}]" if active else "quiet")
+        age = g("anomaly/last_event_age_s")
+        last = f", last event {age:.1f}s ago" if age is not None else ""
+        bundle = g("anomaly/last_bundle")
+        bundle = f", bundle {bundle}" if bundle else ""
+        lines.append(
+            f"alerts {state}, {int(g('anomaly/events', 0))} event(s), "
+            f"{int(g('anomaly/bundles', 0))} bundle(s){last}{bundle}")
+
     # multi-replica points (serving/cluster.py): a cluster-total line plus
     # one health/occupancy row per replica<i>/ namespace. The totals above
     # already aggregate across replicas — this section shows the split.
