@@ -23,6 +23,17 @@ from .cluster import (
     ServingCluster,
 )
 from .engine import PagedKVConfig, RecoveryReport, ServingEngine, StepTimings
+from .frontend import (
+    EV_STREAM_DELTA,
+    EV_STREAM_ERROR,
+    EV_STREAM_FINISH,
+    EV_STREAM_FIRST,
+    ServingFrontend,
+    StreamEvent,
+    StreamStall,
+    TokenStream,
+    predict_ttft,
+)
 from .journal import JournalError, JournalScan, RequestJournal
 from .metrics import Counter, Histogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixCacheConfig
@@ -34,6 +45,7 @@ from .request import (
     REJECT_DEADLINE,
     REJECT_DRAINING,
     REJECT_OVERLOAD,
+    REJECT_PREDICTED_TTFT,
     REJECT_PROMPT_TOO_LONG,
     REJECT_QUEUE_FULL,
     REJECT_UNHEALTHY,
@@ -41,9 +53,10 @@ from .request import (
     RequestOutput,
     SamplingParams,
     SLOSpec,
+    SubmitOptions,
     SubmitResult,
 )
-from .scheduler import FIFOScheduler
+from .scheduler import FairScheduler, FIFOScheduler
 from .speculation import ModelDrafter, NGramDrafter, SpeculationConfig
 from .supervisor import (
     EngineSupervisor,
@@ -85,6 +98,17 @@ __all__ = [
     "Counter",
     "Histogram",
     "FIFOScheduler",
+    "FairScheduler",
+    "ServingFrontend",
+    "TokenStream",
+    "StreamEvent",
+    "StreamStall",
+    "predict_ttft",
+    "EV_STREAM_FIRST",
+    "EV_STREAM_DELTA",
+    "EV_STREAM_FINISH",
+    "EV_STREAM_ERROR",
+    "SubmitOptions",
     "SpeculationConfig",
     "NGramDrafter",
     "ModelDrafter",
@@ -115,4 +139,5 @@ __all__ = [
     "REJECT_DRAINING",
     "REJECT_UNHEALTHY",
     "REJECT_OVERLOAD",
+    "REJECT_PREDICTED_TTFT",
 ]

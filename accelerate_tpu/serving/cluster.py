@@ -577,6 +577,7 @@ class ServingCluster:
             deadline_s=None if admitted else sub.get("deadline_s"),
             cache_prefix=bool(sub.get("cache_prefix", True)),
             priority=int(sub.get("priority", 0)),
+            tenant=str(sub.get("tenant", "")),
             resume_tokens=toks[:keep],
         )
         result = self._place(request, resumed=True)
@@ -691,6 +692,10 @@ class ServingCluster:
                     gauges[f"serving/mem/{k}"] = v
                 for k, v in rep.engine.capacity_headroom().items():
                     gauges[f"serving/headroom/{k}"] = v
+                class_gauges = getattr(rep.engine.scheduler, "class_gauges",
+                                       None)
+                if callable(class_gauges):
+                    gauges.update(class_gauges())
             hb = rep.supervisor.heartbeat()
             gauges["cluster/healthy"] = int(rep.healthy)
             gauges["cluster/brownout_level"] = hb["brownout_level"]

@@ -335,6 +335,7 @@ class ServingEngine:
         paged_attention: str = "gather",
         speculation: Any = None,
         anomaly: Any = None,
+        scheduler: Any = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -548,11 +549,21 @@ class ServingEngine:
                 f"no prompt bucket fits n_positions={self.max_len}: {prompt_buckets}"
             )
         # cap admitted prompts one short of the context so every request can
-        # emit at least one token
-        self.scheduler = FIFOScheduler(
-            prompt_buckets=buckets, max_queue=max_queue,
-            max_prompt_len=min(buckets[-1], self.max_len - 1),
-        )
+        # emit at least one token. ``scheduler=`` swaps the ordering policy
+        # (e.g. `FairScheduler` for the front door's priority classes) — the
+        # engine re-stamps bucket/length limits so any policy sees the same
+        # admission geometry as the default FIFO; ordering is the ONLY thing
+        # a scheduler may change.
+        if scheduler is not None:
+            self.scheduler = scheduler
+            self.scheduler.buckets = buckets
+            self.scheduler.max_queue = int(max_queue)
+            self.scheduler.max_prompt_len = min(buckets[-1], self.max_len - 1)
+        else:
+            self.scheduler = FIFOScheduler(
+                prompt_buckets=buckets, max_queue=max_queue,
+                max_prompt_len=min(buckets[-1], self.max_len - 1),
+            )
         self.eos_token_id = eos_token_id
         self.metrics = metrics or ServingMetrics()
         self.tracker = tracker
@@ -2128,6 +2139,7 @@ class ServingEngine:
                 resume_tokens=toks[:keep],
                 arrival_time=perf_now - waited,
                 priority=int(e.get("priority", 0)),
+                tenant=str(e.get("tenant", "")),
             )
             if self.tracer.enabled:
                 self.tracer.emit(EV_SUBMIT, rid, prompt_len=plen,

@@ -1,0 +1,594 @@
+"""Production front door: token streaming, class-based submission, and
+SLO-predictive admission over a `ServingEngine`, `EngineSupervisor`, or
+`ServingCluster` (docs/serving.md "Front door").
+
+Three capabilities, all host-side (the jitted decode path never sees any of
+this, so a streamed request's tokens are bit-for-bit the completed-output
+path's):
+
+- **Token streaming** — `ServingFrontend.submit_stream` returns a
+  `TokenStream` that yields `StreamEvent`s (first-token, progress deltas,
+  finish/error) fed from the request journal's FIRST_TOKEN/PROGRESS record
+  spine. Reading the *journal* rather than an in-process callback is the
+  whole design: the journal is the engine's crash-exact replay frontier, so
+  a stream survives SIGKILL + `resume()` and cluster replica migration with
+  no duplicated and no lost tokens. Exactly-once delivery falls out of the
+  records' cumulative ``n``: a stream remembers how many tokens it has
+  delivered and only ever emits the suffix beyond that, which absorbs
+  crash-replays, watchdog rewinds, and migration re-journaling uniformly.
+
+- **Class-based submission** — `SubmitOptions` (priority class, tenant, SLO,
+  deadline) stamped onto each request; pair the frontend with a
+  `scheduler.FairScheduler` on the engine to get priority classes with
+  per-tenant deficit fair sharing and starvation bounds. With the default
+  FIFO scheduler the options still ride along (brownout + SLO accounting
+  read them) but ordering stays strictly FIFO.
+
+- **Predictive admission** — `predict_ttft` estimates the TTFT a new request
+  would see from `capacity_headroom()`, queue depth, and the step-phase
+  timing EMAs, and `submit` rejects with `REJECT_PREDICTED_TTFT` *before*
+  an `SLOSpec.ttft_s` is doomed — a distinct reason code from the
+  supervisor's reactive brownout (`REJECT_OVERLOAD`), because "we predict
+  you'd miss" and "we are shedding load" need different client responses.
+  The estimator never rejects blind: when it cannot predict (no observed
+  rate yet) it admits.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Iterable, Iterator
+
+from .journal import (
+    MAGIC,
+    MAX_RECORD_BYTES,
+    REC_FINISH,
+    REC_FIRST_TOKEN,
+    REC_PROGRESS,
+)
+from .request import (
+    FINISH_ABORTED,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    REJECT_PREDICTED_TTFT,
+    Request,
+    SamplingParams,
+    SubmitOptions,
+    SubmitResult,
+)
+
+_FRAME = struct.Struct("<II")
+
+# StreamEvent kinds
+EV_STREAM_FIRST = "first_token"
+EV_STREAM_DELTA = "delta"
+EV_STREAM_FINISH = "finish"
+EV_STREAM_ERROR = "error"
+
+# finish reasons that are a normal end of stream; anything else (watchdog
+# FINISH_ERROR, "rejected:*", supervisor fail-loud reasons) surfaces as an
+# EV_STREAM_ERROR event so a streaming caller can distinguish "done" from
+# "gave up" without string-matching reasons
+_CLEAN_FINISH = frozenset({FINISH_EOS, FINISH_LENGTH, FINISH_ABORTED})
+
+
+class StreamStall(RuntimeError):
+    """Iterating a `TokenStream` stepped the serving target repeatedly
+    without the stream's journal frontier advancing — the request is neither
+    progressing nor finished (a wiring bug, not a transient)."""
+
+
+@dataclass(frozen=True)
+class StreamEvent:
+    """One incremental delivery on a `TokenStream`.
+
+    ``tokens`` is the NEW token suffix this event carries (never previously
+    delivered on this stream); ``n`` is the cumulative stream length after
+    it. ``finish_reason`` is set only on finish/error events. ``lag_s`` is
+    the journal-append -> delivery latency of the record that produced the
+    event (the streaming overhead `serving/stream_lag_s` tracks)."""
+
+    kind: str
+    request_id: int
+    tokens: tuple[int, ...] = ()
+    n: int = 0
+    finish_reason: str | None = None
+    lag_s: float | None = None
+
+
+class _JournalTailer:
+    """Incremental reader over one journal file: parse frames appended since
+    the last poll, maintaining per-rid cumulative token state with the same
+    base-rewind rule as `RequestJournal.scan`.
+
+    Crash/compaction tolerant: a torn tail (short frame / bad CRC at the
+    frontier) simply stops the poll — the bytes are retried next time, by
+    which point the writer has either completed the frame or (on reopen)
+    truncated it. A file that SHRANK (auto-compaction, or the writer's
+    reopen-truncate) resets the tailer to re-read from the magic; replayed
+    records are absorbed by the cumulative-``n`` dedup in `TokenStream`.
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._offset = len(MAGIC)
+        self._carry = b""
+        # rid -> cumulative tokens / (reason, tokens) / wall ts of the last
+        # record that touched the rid
+        self.tokens: dict[int, list[int]] = {}
+        self.finishes: dict[int, tuple[str, list[int]]] = {}
+        self.last_ts: dict[int, float] = {}
+
+    def _reset(self) -> None:
+        self._offset = len(MAGIC)
+        self._carry = b""
+        self.tokens.clear()
+        self.finishes.clear()
+        self.last_ts.clear()
+
+    def poll(self) -> bool:
+        """Consume newly appended complete frames; True if anything new."""
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return False
+        if size < self._offset:
+            self._reset()
+        if size <= self._offset:
+            return False
+        with open(self.path, "rb") as f:
+            if self._offset == len(MAGIC):
+                if f.read(len(MAGIC)) != MAGIC:
+                    return False
+            else:
+                f.seek(self._offset)
+            data = self._carry + f.read(size - self._offset)
+        # consume complete frames; whatever is left is the torn tail — keep
+        # the offset at the last complete frame so the next poll retries it
+        pos = 0
+        advanced = False
+        while pos + _FRAME.size <= len(data):
+            length, crc = _FRAME.unpack_from(data, pos)
+            start = pos + _FRAME.size
+            if length > MAX_RECORD_BYTES:
+                break
+            if start + length > len(data):
+                break  # incomplete frame: the append in flight
+            payload = data[start:start + length]
+            if zlib.crc32(payload) != crc:
+                break
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                break
+            self._apply(rec)
+            pos = start + length
+            advanced = True
+        self._offset += pos
+        self._carry = b""
+        return advanced
+
+    def _apply(self, rec: dict[str, Any]) -> None:
+        rtype = rec.get("t")
+        rid = rec.get("rid")
+        if rid is None:
+            return
+        rid = int(rid)
+        if rtype in (REC_FIRST_TOKEN, REC_PROGRESS):
+            toks = [int(t) for t in rec.get("toks", ())]
+            n = int(rec.get("n", 0))
+            have = self.tokens.setdefault(rid, [])
+            base = n - len(toks)
+            if 0 <= base <= len(have):
+                self.tokens[rid] = have[:base] + toks
+            self.last_ts[rid] = float(rec.get("ts", 0.0))
+        elif rtype == REC_FINISH:
+            self.finishes[rid] = (str(rec.get("reason", "")),
+                                  [int(t) for t in rec.get("toks", ())])
+            self.last_ts[rid] = float(rec.get("ts", 0.0))
+
+
+class TokenStream(Iterator[StreamEvent]):
+    """A live view of one request's token stream, fed from the journal spine
+    (`ServingFrontend.submit_stream` / `resume_stream`).
+
+    Iterate it to drive the serving target until the request finishes::
+
+        stream = frontend.submit_stream([1, 2, 3])
+        assert stream.result.accepted
+        for ev in stream:          # steps the engine/cluster as needed
+            consume(ev.tokens)
+
+    Or poll non-blockingly from an external loop that steps the target
+    itself: ``stream.poll()`` returns whatever events are newly available.
+
+    ``delivered`` is the exactly-once frontier: every token in it was
+    yielded to the caller exactly once, and re-journaled prefixes (crash
+    resume, migration, watchdog rewind) below that frontier are verified
+    against it — a divergence raises (determinism is the contract that makes
+    journal-fed streaming exactly-once, so a violation must be loud)."""
+
+    def __init__(self, frontend: "ServingFrontend", request_id: int,
+                 result: SubmitResult, *, delivered: list[int] | None = None):
+        self._frontend = frontend
+        self.request_id = int(request_id)
+        self.result = result
+        self.delivered: list[int] = list(delivered or [])
+        self.finish_reason: str | None = None
+        self._pending: deque[StreamEvent] = deque()
+        self._first_delivered = bool(self.delivered)
+        self._t_submit = frontend._clock()
+
+    @property
+    def delivered_n(self) -> int:
+        return len(self.delivered)
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+    # ----------------------------------------------------------- delivery
+    def poll(self) -> list[StreamEvent]:
+        """Drain newly journaled tokens into events (non-blocking; never
+        steps the target)."""
+        if self.finished:
+            out = list(self._pending)
+            self._pending.clear()
+            return out
+        fe = self._frontend
+        tailer = fe._tailer_for(self.request_id)
+        if tailer is None:
+            return []
+        tailer.poll()
+        erid = fe._engine_rid(self.request_id)
+        cum = tailer.tokens.get(erid, [])
+        fin = tailer.finishes.get(erid)
+        if fin is not None:
+            reason, toks = fin
+            # the FINISH record carries the full stream — it may extend past
+            # the last PROGRESS-cadence record
+            if len(toks) >= len(cum):
+                cum = toks
+        self._emit_suffix(cum, tailer.last_ts.get(erid))
+        if fin is not None:
+            reason, _ = fin
+            kind = (EV_STREAM_FINISH if reason in _CLEAN_FINISH
+                    else EV_STREAM_ERROR)
+            self.finish_reason = reason
+            self._pending.append(StreamEvent(
+                kind=kind, request_id=self.request_id,
+                n=self.delivered_n, finish_reason=reason,
+                lag_s=self._lag(tailer.last_ts.get(erid))))
+            fe._close_stream(self)
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    def _lag(self, rec_ts: float | None) -> float | None:
+        if not rec_ts:
+            return None
+        return max(0.0, time.time() - rec_ts)
+
+    def _emit_suffix(self, cum: list[int], rec_ts: float | None) -> None:
+        have = self.delivered_n
+        if len(cum) > have:
+            # exactly-once dedup: verify any re-journaled overlap, then emit
+            # only the unseen suffix
+            if cum[:have] != self.delivered:
+                raise StreamStall(
+                    f"stream {self.request_id}: re-journaled prefix diverges "
+                    f"from delivered tokens (journal replay is supposed to "
+                    f"be deterministic)")
+            new = cum[have:]
+            self.delivered.extend(new)
+            lag = self._lag(rec_ts)
+            fe = self._frontend
+            if lag is not None:
+                fe.metrics.stream_lag_s.observe(lag)
+            if not self._first_delivered:
+                self._first_delivered = True
+                fe.metrics.streamed_ttft_s.observe(
+                    fe._clock() - self._t_submit)
+                self._pending.append(StreamEvent(
+                    kind=EV_STREAM_FIRST, request_id=self.request_id,
+                    tokens=tuple(new), n=self.delivered_n, lag_s=lag))
+            else:
+                self._pending.append(StreamEvent(
+                    kind=EV_STREAM_DELTA, request_id=self.request_id,
+                    tokens=tuple(new), n=self.delivered_n, lag_s=lag))
+            fe.metrics.stream_events.inc()
+
+    # ---------------------------------------------------------- iteration
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> StreamEvent:
+        if self._pending:
+            return self._pending.popleft()
+        if not self.result.accepted:
+            raise StopIteration
+        stalls = 0
+        while True:
+            events = self.poll()
+            if events:
+                self._pending.extend(events[1:])
+                return events[0]
+            if self.finished:
+                raise StopIteration
+            self._frontend._step()
+            stalls += 1
+            if stalls > self._frontend.max_stall_steps:
+                raise StreamStall(
+                    f"stream {self.request_id}: no progress after "
+                    f"{stalls} steps (request neither decoding nor finished)")
+
+
+def predict_ttft(
+    headroom: dict[str, Any],
+    step_timings: dict[str, float] | None = None,
+    *,
+    max_concurrency: int | None = None,
+) -> float | None:
+    """Estimate the TTFT a request submitted NOW would see, from the
+    engine's `capacity_headroom()` gauges and its last step-phase breakdown
+    (docs/serving.md "Front door").
+
+    The model is deliberately coarse but deterministic and monotone in load:
+
+    - a free slot with no queue ahead costs one engine step (the admission
+      prefill rides the next `step()` call): ``total_s`` of the step-phase
+      EMA spine;
+    - otherwise the request waits for ``queue_depth - slots_free + 1``
+      retirements: the first at ``est_slot_free_s`` (the engine's own
+      next-slot estimate), subsequent ones spread over the aggregate drain
+      time (``decode_tokens_remaining / decode_tokens_per_sec`` across the
+      busy slots).
+
+    Returns None when no prediction is possible (no observed decode rate
+    and no free slot) — the caller must treat None as "admit", never as a
+    rejection: predictive admission sheds on evidence, not on ignorance.
+    """
+    step_s = float((step_timings or {}).get("total_s", 0.0) or 0.0)
+    free = int(headroom.get("slots_free", 0))
+    queue = int(headroom.get("queue_depth", 0))
+    if free > queue:
+        return step_s
+    waits_for = queue - free + 1
+    w0 = headroom.get("est_slot_free_s")
+    if w0 is None:
+        return None
+    rate = float(headroom.get("decode_tokens_per_sec") or 0.0)
+    drain_tokens = float(headroom.get("decode_tokens_remaining", 0))
+    busy = None
+    if max_concurrency is not None:
+        busy = max(1, int(max_concurrency) - free)
+    if rate > 0 and busy:
+        per_retire = (drain_tokens / rate) / busy
+    else:
+        per_retire = float(w0)
+    return float(w0) + (waits_for - 1) * per_retire + step_s
+
+
+class ServingFrontend:
+    """The production front door over a serving target — a `ServingEngine`,
+    an `EngineSupervisor`, or a `ServingCluster` (anything with ``submit`` /
+    ``step`` / ``has_work`` and a journal behind it).
+
+    ``admission=True`` (default) turns on predictive admission for requests
+    that carry an ``SLOSpec.ttft_s`` bound; ``admission_margin`` scales the
+    bound (1.0 = reject when the estimate exceeds the bound exactly; 0.8 =
+    keep 20% predicted slack). ``clock`` is injectable so admission
+    decisions are deterministic under test.
+
+    The target MUST be journaled for streaming (`submit_stream`): the
+    journal is the stream's transport. Plain `submit` works either way.
+    """
+
+    def __init__(self, target: Any, *, admission: bool = True,
+                 admission_margin: float = 1.0,
+                 clock: Any = time.perf_counter,
+                 max_stall_steps: int = 4096):
+        self.target = target
+        self.admission = bool(admission)
+        self.admission_margin = float(admission_margin)
+        self._clock = clock
+        self.max_stall_steps = int(max_stall_steps)
+        self._streams: dict[int, TokenStream] = {}
+        self._tailers: dict[Path, _JournalTailer] = {}
+
+    # -------------------------------------------------------- target shims
+    @property
+    def _is_cluster(self) -> bool:
+        return hasattr(self.target, "replicas")
+
+    @property
+    def metrics(self) -> Any:
+        """The `ServingMetrics` the frontend accounts into: the target's own
+        for an engine/supervisor; the first replica's for a cluster (the
+        cluster metrics view is a read-only aggregate — counters bumped on
+        replica 0 flow into it)."""
+        if self._is_cluster:
+            return self.target.replicas[0].metrics
+        return self.target.metrics
+
+    def _engine(self) -> Any:
+        t = self.target
+        return t.engine if hasattr(t, "engine") else t
+
+    def _step(self) -> list[Any]:
+        return self.target.step()
+
+    def _headroom(self) -> dict[str, Any] | None:
+        fn = getattr(self.target, "capacity_headroom", None)
+        if fn is None:
+            fn = getattr(self._engine(), "capacity_headroom", None)
+        return fn() if callable(fn) else None
+
+    def _step_timings(self) -> dict[str, float]:
+        """The step-phase EMA spine (PR-14): the engine's last breakdown, or
+        the slowest replica's for a cluster (conservative)."""
+        if self._is_cluster:
+            best: dict[str, float] = {}
+            for rep in self.target.replicas:
+                if not rep.healthy:
+                    continue
+                t = rep.engine.last_step_timings
+                if t and t.get("total_s", 0.0) >= best.get("total_s", 0.0):
+                    best = t
+            return best
+        eng = self._engine()
+        return getattr(eng, "last_step_timings", {}) or {}
+
+    def _max_concurrency(self) -> int | None:
+        if self._is_cluster:
+            total = 0
+            for rep in self.target.replicas:
+                if rep.healthy:
+                    total += int(rep.engine.max_concurrency)
+            return total or None
+        return getattr(self._engine(), "max_concurrency", None)
+
+    # -------------------------------------------------- journal resolution
+    def _placement(self, rid: int) -> tuple[Path, int] | None:
+        """(journal path, engine rid) currently serving stream ``rid`` —
+        re-resolved every poll, so a cluster migration or a supervisor
+        restart transparently re-points the tailer."""
+        t = self.target
+        if self._is_cluster:
+            placed = t.placement(rid)
+            if placed is None:
+                return None
+            rep_idx, erid = placed
+            return Path(t.replicas[rep_idx].journal_path), erid
+        journal = getattr(self._engine(), "journal", None)
+        if journal is None:
+            return None
+        return Path(journal.path), rid
+
+    def _tailer_for(self, rid: int) -> _JournalTailer | None:
+        placed = self._placement(rid)
+        if placed is None:
+            return None
+        path, _ = placed
+        tailer = self._tailers.get(path)
+        if tailer is None:
+            tailer = _JournalTailer(path)
+            self._tailers[path] = tailer
+        return tailer
+
+    def _engine_rid(self, rid: int) -> int:
+        placed = self._placement(rid)
+        return placed[1] if placed is not None else rid
+
+    # ----------------------------------------------------------- admission
+    def predict_ttft_now(self) -> float | None:
+        """The TTFT estimate `submit` would gate on right now."""
+        headroom = self._headroom()
+        if headroom is None:
+            return None
+        return predict_ttft(headroom, self._step_timings(),
+                            max_concurrency=self._max_concurrency())
+
+    def _admission_check(self, request: Request,
+                         options: SubmitOptions | None) -> SubmitResult | None:
+        slo = request.slo
+        if (not self.admission or slo is None or slo.ttft_s is None
+                or (options is not None and options.admit_despite_slo)):
+            return None
+        predicted = self.predict_ttft_now()
+        if predicted is None:
+            return None
+        self.metrics.predicted_ttft_s.observe(predicted)
+        if predicted <= float(slo.ttft_s) * self.admission_margin:
+            return None
+        self.metrics.observe_shed(getattr(request, "priority", 0))
+        self.metrics.requests_rejected.inc()
+        return SubmitResult(
+            False, request.request_id, REJECT_PREDICTED_TTFT,
+            f"predicted TTFT {predicted:.3f}s > "
+            f"slo {float(slo.ttft_s):.3f}s ({slo.name})")
+
+    # -------------------------------------------------------------- submit
+    def _build_request(self, prompt: Request | Iterable[int],
+                       params: SamplingParams | None,
+                       options: SubmitOptions | None) -> Request:
+        if isinstance(prompt, Request):
+            request = prompt
+        else:
+            request = Request(prompt=list(prompt),
+                              params=params or SamplingParams())
+        if options is not None:
+            options.apply(request)
+        return request
+
+    def submit(self, prompt: Request | Iterable[int],
+               params: SamplingParams | None = None,
+               options: SubmitOptions | None = None) -> SubmitResult:
+        """Class-aware, admission-gated submit. Same backpressure contract
+        as `ServingEngine.submit` — never blocks, rejects with a reason."""
+        request = self._build_request(prompt, params, options)
+        rejected = self._admission_check(request, options)
+        if rejected is not None:
+            return rejected
+        return self.target.submit(request)
+
+    def submit_stream(self, prompt: Request | Iterable[int],
+                      params: SamplingParams | None = None,
+                      options: SubmitOptions | None = None) -> TokenStream:
+        """Submit and return a live `TokenStream` over the request's journal
+        spine. Check ``stream.result.accepted`` before iterating — a
+        rejected submission yields no events."""
+        request = self._build_request(prompt, params, options)
+        rejected = self._admission_check(request, options)
+        if rejected is not None:
+            return TokenStream(self, -1 if rejected.request_id is None
+                               else rejected.request_id, rejected)
+        result = self.target.submit(request)
+        if not result.accepted:
+            return TokenStream(self, -1 if result.request_id is None
+                               else result.request_id, result)
+        if self._placement(result.request_id) is None:
+            raise ValueError(
+                "submit_stream needs a journaled target: the journal IS the "
+                "stream transport (pass journal= to the engine, or use a "
+                "supervisor/cluster workdir)")
+        stream = TokenStream(self, result.request_id, result)
+        self._streams[result.request_id] = stream
+        self.metrics.streams_opened.inc()
+        return stream
+
+    def resume_stream(self, request_id: int,
+                      delivered: list[int] | None = None) -> TokenStream:
+        """Re-attach a stream to a request already known to the target —
+        after a crash-exact `resume()`, or to observe a request submitted
+        elsewhere. ``delivered`` is the token prefix the caller already
+        consumed pre-crash: delivery resumes exactly after it (and the
+        re-decoded overlap is verified against it)."""
+        stream = TokenStream(
+            self, request_id,
+            SubmitResult(True, request_id), delivered=delivered)
+        self._streams[request_id] = stream
+        self.metrics.streams_opened.inc()
+        return stream
+
+    def _close_stream(self, stream: TokenStream) -> None:
+        self.metrics.streams_finished.inc()
+        self._streams.pop(stream.request_id, None)
+
+    # ------------------------------------------------------------- pumping
+    def open_streams(self) -> list[TokenStream]:
+        return list(self._streams.values())
+
+    def pump(self) -> list[StreamEvent]:
+        """Poll every open stream once (no stepping): the integration hook
+        for callers that own the step loop."""
+        events: list[StreamEvent] = []
+        for stream in list(self._streams.values()):
+            events.extend(stream.poll())
+        return events

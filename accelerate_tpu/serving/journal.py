@@ -85,6 +85,7 @@ def request_record(request: Any) -> dict[str, Any]:
         "deadline_s": request.deadline_s,
         "cache_prefix": bool(request.cache_prefix),
         "priority": int(getattr(request, "priority", 0)),
+        "tenant": str(getattr(request, "tenant", "") or ""),
     }
 
 

@@ -246,6 +246,28 @@ class ServingMetrics:
         # (`device_get`), detokenize/delivery, journal appends+fsync, and
         # telemetry export — plus the whole-step wall. One observation per
         # step; the per-step dict rides EV_DISPATCH/EV_FETCH as ``phases``.
+        # front-door telemetry (serving/frontend.py — docs/serving.md "Front
+        # door"): streamed requests opened / finished; stream events
+        # delivered to callers (first-token + progress + finish);
+        # ``streamed_ttft_s`` is TTFT as a STREAMING caller experiences it
+        # (submit -> first StreamEvent delivered from the journal spine, so
+        # it includes the tail-poll lag that completed-output TTFT hides);
+        # ``stream_lag_s`` is that delivery lag alone (journal append ->
+        # event yielded); ``predicted_ttft_s`` records every predictive-
+        # admission estimate, and ``requests_shed_predicted`` counts the
+        # submissions the front door rejected with REJECT_PREDICTED_TTFT
+        # *before* a doomed SLO burned a slot (distinct from the
+        # supervisor's reactive brownout shed)
+        self.streams_opened = Counter()
+        self.streams_finished = Counter()
+        self.stream_events = Counter()
+        self.streamed_ttft_s = Histogram()
+        self.stream_lag_s = Histogram()
+        self.predicted_ttft_s = Histogram()
+        self.requests_shed_predicted = Counter()
+        # sheds per priority class (``serving/class/<p>/shed``): which class
+        # the predictive gate actually pushes back on
+        self.class_shed: dict[int, int] = {}
         self.step_phase_schedule_s = Histogram()
         self.step_phase_draft_s = Histogram()
         self.step_phase_dispatch_s = Histogram()
@@ -335,6 +357,13 @@ class ServingMetrics:
             },
         }
 
+    def observe_shed(self, priority: int) -> None:
+        """One predictive-admission rejection (REJECT_PREDICTED_TTFT),
+        attributed to its priority class."""
+        self.requests_shed_predicted.inc()
+        p = int(priority)
+        self.class_shed[p] = self.class_shed.get(p, 0) + 1
+
     def observe_step(self, active: int, capacity: int, queue_depth: int) -> None:
         self.steps.inc()
         self.slot_occupancy.observe(active / capacity if capacity else 0.0)
@@ -409,6 +438,11 @@ class ServingMetrics:
             "serving/accepted_tokens_per_forward": (
                 self.spec_tokens.value / self.spec_forwards.value
                 if self.spec_forwards.value else 0.0),
+            "serving/streams_opened": self.streams_opened.value,
+            "serving/streams_finished": self.streams_finished.value,
+            "serving/stream_events": self.stream_events.value,
+            "serving/requests_shed_predicted": (
+                self.requests_shed_predicted.value),
             "supervisor/restarts": self.supervisor_restarts.value,
             "supervisor/stalls_detected": self.supervisor_stalls.value,
             "supervisor/storms_detected": self.supervisor_storms.value,
@@ -428,6 +462,8 @@ class ServingMetrics:
                 out[f"serving/slo/{name}/{stat}"] = stats[stat]
         for key, seconds in self.compiles.items():
             out[f"serving/compile/{key}"] = seconds
+        for p, n in sorted(self.class_shed.items()):
+            out[f"serving/class/{p}/shed"] = n
         for name, hist in (
             ("collective_s", self.collective_s),
             ("replica_occupancy", self.replica_occupancy),
@@ -444,6 +480,9 @@ class ServingMetrics:
             ("admit_batch_size", self.admit_batch_size),
             ("tokens_per_dispatch", self.tokens_per_dispatch),
             ("spec_accept_len", self.spec_accept_len),
+            ("streamed_ttft_s", self.streamed_ttft_s),
+            ("stream_lag_s", self.stream_lag_s),
+            ("predicted_ttft_s", self.predicted_ttft_s),
             ("step_phase_schedule_s", self.step_phase_schedule_s),
             ("step_phase_draft_s", self.step_phase_draft_s),
             ("step_phase_dispatch_s", self.step_phase_dispatch_s),

@@ -26,6 +26,12 @@ REJECT_DRAINING = "draining"  # engine is draining toward shutdown
 # failed loudly, or the overload brownout is shedding low-priority admissions
 REJECT_UNHEALTHY = "unhealthy"
 REJECT_OVERLOAD = "overload"
+# front-door predictive admission (serving/frontend.py): the TTFT this request
+# would see — estimated from capacity headroom, queue depth, and step-phase
+# timing EMAs — already exceeds its SLOSpec.ttft_s bound, so it is shed BEFORE
+# a slot and prefill are wasted on a reply the client will count as a miss.
+# Distinct from REJECT_OVERLOAD, which is the supervisor's *reactive* brownout.
+REJECT_PREDICTED_TTFT = "predicted_ttft"
 
 
 @dataclass(frozen=True)
@@ -108,10 +114,17 @@ class Request:
     slo: SLOSpec | None = None
     resume_tokens: list[int] = field(default_factory=list)
     # admission priority class (higher = more important; default 0 = lowest).
-    # Only the supervisor's overload BROWNOUT reads it: at brownout level L,
-    # new admissions with priority < L are shed with REJECT_OVERLOAD
-    # (serving/supervisor.py). Scheduling order is unaffected — FIFO holds.
+    # Read in two places: the supervisor's overload BROWNOUT sheds new
+    # admissions with priority < level (REJECT_OVERLOAD,
+    # serving/supervisor.py), and the `FairScheduler` serves higher classes
+    # first within its starvation bound. Under the default `FIFOScheduler`
+    # scheduling order is unaffected — FIFO holds.
     priority: int = 0
+    # fair-share accounting key (`FairScheduler`): requests with the same
+    # tenant share one deficit-weighted budget, so one chatty client cannot
+    # monopolize its priority class. Journaled and restored across crash
+    # resume and replica migration. "" = the anonymous shared tenant.
+    tenant: str = ""
 
     @property
     def prefill_len(self) -> int:
@@ -138,6 +151,40 @@ class RequestOutput:
     arrival_time: float | None = None
     first_token_time: float | None = None
     finish_time: float | None = None
+
+
+@dataclass(frozen=True)
+class SubmitOptions:
+    """Per-request front-door knobs (`serving/frontend.py`): everything a
+    caller chooses ABOUT a submission rather than IN it.
+
+    ``priority`` picks the scheduling class (higher served first, subject to
+    the `FairScheduler` starvation bound); ``tenant`` names the fair-share
+    account the request bills against; ``slo`` attaches the latency objective
+    that both predictive admission (reject with `REJECT_PREDICTED_TTFT` when
+    the estimated TTFT already busts ``slo.ttft_s``) and retirement-time
+    attainment accounting read; ``deadline_s`` is the queue-wait budget
+    (`REJECT_DEADLINE`); ``cache_prefix`` opts out of prefix-KV reuse.
+    ``admit_despite_slo`` submits even when predictive admission would reject
+    (the caller prefers a late answer over no answer)."""
+
+    priority: int = 0
+    tenant: str = ""
+    slo: SLOSpec | None = None
+    deadline_s: float | None = None
+    cache_prefix: bool = True
+    admit_despite_slo: bool = False
+
+    def apply(self, request: Request) -> Request:
+        """Stamp these options onto ``request`` (mutates and returns it)."""
+        request.priority = int(self.priority)
+        request.tenant = str(self.tenant)
+        if self.slo is not None:
+            request.slo = self.slo
+        if self.deadline_s is not None:
+            request.deadline_s = float(self.deadline_s)
+        request.cache_prefix = bool(self.cache_prefix)
+        return request
 
 
 @dataclass(frozen=True)

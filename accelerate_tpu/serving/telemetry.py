@@ -290,6 +290,13 @@ class TelemetryExporter:
         if head is not None:
             for k, v in head().items():
                 gauges[f"serving/headroom/{k}"] = v
+        # class-based scheduler (serving/scheduler.FairScheduler): per-class
+        # queue depth / starvation-promotion gauges under serving/class/...
+        # (absent for the default FIFO scheduler — no classes, no rows)
+        sched = getattr(engine, "scheduler", None)
+        class_gauges = getattr(sched, "class_gauges", None)
+        if callable(class_gauges):
+            gauges.update(class_gauges())
         # anomaly monitor (serving/anomaly.py): active-detector count, event/
         # bundle counters, last-event age, and the latest bundle path (a
         # string — JSONL-only; the Prometheus render drops it by design)

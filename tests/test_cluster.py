@@ -512,3 +512,55 @@ def test_chaos_replica_kill_zero_lost_zero_drift():
     assert summary["detail"]["parity_drift"] == 0
     assert summary["detail"]["migrations"] >= 1
     assert summary["detail"]["journals_clean"] == 2
+
+
+# ------------------------------------------------- front-door stream survival
+@_drives_engine
+@pytest.mark.frontend
+def test_stream_survives_replica_migration_bit_exact(model, tmp_path):
+    """The front-door leg of the migration contract: a `TokenStream` opened
+    through `ServingFrontend` keeps delivering across a replica kill — the
+    tailer re-points to the survivor's journal via `placement()`, the
+    re-journaled prefix is absorbed by the exactly-once frontier, and every
+    stream finishes bit-for-bit the solo `generate`'s with no duplicated
+    and no lost tokens."""
+    from accelerate_tpu.serving import ServingFrontend
+
+    module, params = model
+    cluster = ServingCluster(
+        _factory(module, params), tmp_path, replicas=2,
+        config=ClusterConfig(policy=POLICY_ROUND_ROBIN),
+        supervisor_config=SupervisorConfig(max_restarts=0))
+    fe = ServingFrontend(cluster)
+    prompts = _prompts(13, [5, 9, 12, 7])
+    reqs = _mixed_requests(prompts, 10)
+    streams = [fe.submit_stream(r) for r in reqs]
+    assert all(s.result.accepted for s in streams)
+    assert [cluster.placement(s.request_id)[0] for s in streams] == [0, 1, 0, 1]
+    for _ in range(2):  # emit a few tokens on both replicas first
+        cluster.step()
+        fe.pump()
+    pre_kill = {s.request_id: list(s.delivered) for s in streams}
+    assert any(pre_kill.values())  # at least one stream was mid-flight
+    _kill(cluster.replicas[0])
+    events = {s.request_id: [] for s in streams}
+    while cluster.has_work or fe.open_streams():
+        cluster.step()
+        for ev in fe.pump():
+            events[ev.request_id].append(ev)
+    cluster.close()
+    assert cluster.migrations == 1
+    for i, stream in enumerate(streams):
+        r = reqs[i]
+        assert stream.finished and stream.finish_reason == FINISH_LENGTH
+        ref = _solo(module, params, r.prompt, r.params.max_new_tokens,
+                    temperature=r.params.temperature, top_k=r.params.top_k,
+                    seed=r.params.seed)
+        assert stream.delivered == ref, f"stream {stream.request_id} diverged"
+        # exactly-once across the migration: pre-kill tokens never re-emitted
+        assert stream.delivered[:len(pre_kill[stream.request_id])] == \
+            pre_kill[stream.request_id]
+        flat = [t for ev in events[stream.request_id] for t in ev.tokens]
+        assert pre_kill[stream.request_id] + flat == stream.delivered
+        ns = [ev.n for ev in events[stream.request_id]]
+        assert ns == sorted(ns)

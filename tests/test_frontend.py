@@ -1,0 +1,404 @@
+"""Production front door (`serving/frontend.py`, `serving/scheduler.py`
+`FairScheduler`; docs/serving.md "Front door").
+
+The load-bearing contracts:
+
+- **FIFO parity**: with a single priority class and a single tenant the
+  `FairScheduler` degenerates to exact arrival order, and a greedy request
+  STREAMED through the frontend delivers bit-for-bit the tokens the plain
+  FIFO completed-output path emits (which is itself pinned to solo
+  `generate` by tests/test_serving.py).
+- **Bounded starvation**: no queued request is ever bypassed by more than
+  ``starvation_bound`` later arrivals, regardless of the class/tenant mix —
+  a count, not a wall-clock wait, so it is provable here deterministically.
+- **Predictive admission**: the TTFT estimate is a pure function of the
+  headroom gauges, rejections carry `REJECT_PREDICTED_TTFT` (distinct from
+  the brownout's reactive reason), and "cannot predict" always admits.
+- **Stream survival**: a stream re-attached after SIGKILL + resume, or
+  after a cluster replica migration, finishes byte-identical with no
+  duplicated and no lost tokens (the journal-spine exactly-once contract).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+flax_nn = pytest.importorskip("flax.linen")
+
+pytestmark = [pytest.mark.serving, pytest.mark.frontend]
+
+# engine-driving tests compile this module's jitted serving programs — that
+# budget lives in the slow tier (`pytest -m frontend` runs the full suite);
+# tier-1 keeps the host-only logic: scheduler ordering, the admission model
+_drives_engine = pytest.mark.slow
+
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.serving import (
+    EV_STREAM_FINISH,
+    EV_STREAM_FIRST,
+    FINISH_LENGTH,
+    REJECT_PREDICTED_TTFT,
+    FairScheduler,
+    FIFOScheduler,
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+    ServingMetrics,
+    SLOSpec,
+    SubmitOptions,
+    SubmitResult,
+    predict_ttft,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    return module, params
+
+
+def _solo(module, params, prompt, n, seed=0):
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = generate(module, params, ids, max_new_tokens=n,
+                   temperature=0.0, rng=jax.random.key(seed))
+    return np.asarray(out)[0].tolist()
+
+
+def _prompts(rng_seed, lengths, vocab=256):
+    r = np.random.default_rng(rng_seed)
+    return [r.integers(0, vocab, (n,)).astype(np.int32).tolist() for n in lengths]
+
+
+def _req(rid, *, plen=4, new=4, priority=0, tenant=""):
+    r = Request(prompt=[1] * plen,
+                params=SamplingParams(max_new_tokens=new),
+                request_id=rid)
+    r.priority = priority
+    r.tenant = tenant
+    return r
+
+
+# ------------------------------------------------------ scheduler: ordering
+def test_fair_single_class_single_tenant_is_exact_fifo():
+    """The parity oracle at the scheduler level: one class, one tenant, a
+    random interleave of submits and pops — FairScheduler must emit the
+    exact sequence FIFOScheduler does."""
+    fair = FairScheduler(prompt_buckets=(32,), max_queue=256)
+    fifo = FIFOScheduler(prompt_buckets=(32,), max_queue=256)
+    rng = np.random.default_rng(0)
+    rid = 0
+    popped_fair, popped_fifo = [], []
+    for _ in range(200):
+        if rng.random() < 0.6 or fair.queue_depth == 0:
+            plen = int(rng.integers(1, 30))
+            new = int(rng.integers(1, 50))
+            assert fair.submit(_req(rid, plen=plen, new=new)).accepted
+            assert fifo.submit(_req(rid, plen=plen, new=new)).accepted
+            rid += 1
+        else:
+            a, b = fair.next_ready(), fifo.next_ready()
+            popped_fair.append(a.request_id)
+            popped_fifo.append(b.request_id)
+    popped_fair += [e.request_id for e in fair.drain_queue()]
+    popped_fifo += [e.request_id for e in fifo.drain_queue()]
+    assert popped_fair == popped_fifo == sorted(popped_fair)
+
+
+def test_fair_priority_classes_served_highest_first():
+    s = FairScheduler(prompt_buckets=(32,))
+    for rid, p in enumerate([0, 2, 1, 2, 0]):
+        assert s.submit(_req(rid, priority=p)).accepted
+    order = [s.next_ready().request_id for _ in range(5)]
+    # class 2 first (arrival order within it), then 1, then 0
+    assert order == [1, 3, 2, 0, 4]
+
+
+def test_fair_tenant_deficit_round_robin():
+    """Within one class tenants alternate: each visit grants one quantum,
+    which exactly covers one request here, so service interleaves even
+    though tenant a's whole backlog arrived first."""
+    s = FairScheduler(prompt_buckets=(32,), quantum_tokens=8)
+    rid = 0
+    for _ in range(4):
+        assert s.submit(_req(rid, plen=4, new=4, tenant="a")).accepted
+        rid += 1
+    for _ in range(2):
+        assert s.submit(_req(rid, plen=4, new=4, tenant="b")).accepted
+        rid += 1
+    order = [s.next_ready().request_id for _ in range(6)]
+    assert order == [0, 4, 1, 5, 2, 3]
+
+
+def test_fair_heavy_tenant_cannot_monopolize():
+    """Deficit accounting: a tenant whose requests cost 3 quanta serves one
+    request per THREE visits, so the cheap tenant drains ahead of it."""
+    s = FairScheduler(prompt_buckets=(32,), quantum_tokens=10)
+    # heavy: cost 30 (prompt 10 + 20 new); cheap: cost 10 (prompt 4 + 6 new)
+    assert s.submit(_req(0, plen=10, new=20, tenant="heavy")).accepted
+    assert s.submit(_req(1, plen=10, new=20, tenant="heavy")).accepted
+    for rid in range(2, 6):
+        assert s.submit(_req(rid, plen=4, new=6, tenant="cheap")).accepted
+    order = [s.next_ready().request_id for _ in range(6)]
+    heavy_positions = [order.index(0), order.index(1)]
+    # first heavy request waits for 3 heavy-visits' deficit: two cheap
+    # requests land before it, and the cheap queue fully drains before the
+    # second heavy request accumulates its budget
+    assert heavy_positions[0] >= 2
+    assert heavy_positions[1] == 5
+    assert [r for r in order if r >= 2] == [2, 3, 4, 5]  # cheap stays FIFO
+
+
+def test_fair_starvation_bound_is_a_hard_count():
+    """No request is bypassed more than ``starvation_bound`` times: a
+    low-class request under a steady high-class arrival stream is served by
+    pop ``starvation_bound + 1`` at the latest."""
+    bound = 3
+    s = FairScheduler(prompt_buckets=(32,), starvation_bound=bound)
+    assert s.submit(_req(0, priority=0)).accepted
+    served_at = None
+    for pop in range(1, 20):
+        assert s.submit(_req(100 + pop, priority=5)).accepted
+        if s.next_ready().request_id == 0:
+            served_at = pop
+            break
+    assert served_at is not None and served_at <= bound + 1
+
+
+def test_fair_watchdog_requeue_precedes_everything():
+    s = FairScheduler(prompt_buckets=(32,))
+    assert s.submit(_req(0, priority=9)).accepted
+    s.requeue(_req(7, priority=0))
+    assert s.next_ready().request_id == 7  # front lane beats class 9
+    assert s.next_ready().request_id == 0
+
+
+def test_fair_peek_never_commits_drr_state():
+    s = FairScheduler(prompt_buckets=(32,), quantum_tokens=8)
+    for rid, t in enumerate(["a", "b", "a", "b"]):
+        assert s.submit(_req(rid, tenant=t)).accepted
+    before = [r.request_id for r in s.snapshot_queue()]
+    assert s.peek_run(4) == s.peek_run(4)  # pure: repeatable
+    assert [r.request_id for r in s.snapshot_queue()] == before
+    popped = [r.request_id for r in s.pop_run(4)]
+    assert popped == before  # pop serves exactly the peeked order
+
+
+def test_fair_class_gauges_shape():
+    s = FairScheduler(prompt_buckets=(32,))
+    assert s.submit(_req(0, priority=1, tenant="a")).accepted
+    assert s.submit(_req(1, priority=1, tenant="b")).accepted
+    assert s.submit(_req(2, priority=0)).accepted
+    g = s.class_gauges()
+    assert g["serving/class/1/queue_depth"] == 2
+    assert g["serving/class/1/tenants"] == 2
+    assert g["serving/class/0/queue_depth"] == 1
+    assert g["serving/class/1/starved"] == 0
+
+
+# ------------------------------------------------- predictive admission unit
+def test_predict_ttft_model_arithmetic():
+    timings = {"total_s": 0.1}
+    # free slot, empty queue: one step away
+    assert predict_ttft({"slots_free": 1, "queue_depth": 0}, timings) == 0.1
+    # no free slot, no retirement estimate: cannot predict -> None (admit)
+    assert predict_ttft({"slots_free": 0, "queue_depth": 3,
+                         "est_slot_free_s": None}, timings) is None
+    # queued behind 2 retirements: w0 + 1 * per_retire + step
+    est = predict_ttft(
+        {"slots_free": 0, "queue_depth": 1, "est_slot_free_s": 1.0,
+         "decode_tokens_per_sec": 10.0, "decode_tokens_remaining": 20},
+        timings, max_concurrency=2)
+    assert est == pytest.approx(1.0 + 1.0 + 0.1)  # per_retire = (20/10)/2
+
+
+class _StubTarget:
+    """A headroom-scripted serving target: enough surface for the frontend's
+    admission path (submit/metrics/capacity_headroom/step timings) with no
+    engine behind it, so admission decisions are a pure function of the
+    scripted gauges."""
+
+    def __init__(self, headroom, timings=None):
+        self.metrics = ServingMetrics()
+        self.headroom = dict(headroom)
+        self.last_step_timings = dict(timings or {"total_s": 0.1})
+        self.max_concurrency = 2
+        self.submitted = []
+
+    def capacity_headroom(self):
+        return dict(self.headroom)
+
+    def submit(self, request):
+        request.request_id = len(self.submitted)
+        self.submitted.append(request)
+        return SubmitResult(True, request.request_id)
+
+    def step(self):
+        return []
+
+    @property
+    def has_work(self):
+        return False
+
+
+_BUSY = {"slots_free": 0, "queue_depth": 4, "est_slot_free_s": 2.0,
+         "decode_tokens_per_sec": 10.0, "decode_tokens_remaining": 40}
+
+
+def test_predictive_admission_rejects_with_distinct_reason():
+    target = _StubTarget(_BUSY)
+    fe = ServingFrontend(target)
+    tight = SubmitOptions(slo=SLOSpec(ttft_s=1.0, name="interactive"))
+    res = fe.submit([1, 2, 3], options=tight)
+    assert not res.accepted
+    assert res.reason == REJECT_PREDICTED_TTFT
+    assert target.submitted == []  # rejected BEFORE reaching the queue
+    snap = target.metrics.snapshot()
+    assert snap["serving/requests_shed_predicted"] == 1
+    assert snap["serving/class/0/shed"] == 1
+    # same state, same request -> same decision (deterministic, no clock)
+    assert fe.submit([1, 2, 3], options=tight).reason == REJECT_PREDICTED_TTFT
+
+
+def test_predictive_admission_admits_when_slack_or_blind():
+    # generous SLO: estimate (10.1s for _BUSY) under the bound -> admit
+    assert ServingFrontend(_StubTarget(_BUSY)).submit(
+        [1], options=SubmitOptions(slo=SLOSpec(ttft_s=60.0))).accepted
+    # margin scales the bound: 0.1 margin turns an admit into a reject
+    fe = ServingFrontend(_StubTarget(_BUSY), admission_margin=0.1)
+    assert fe.submit([1], options=SubmitOptions(
+        slo=SLOSpec(ttft_s=60.0))).reason == REJECT_PREDICTED_TTFT
+    # cannot predict (no retirement estimate): ALWAYS admit — sheds on
+    # evidence, not on ignorance
+    blind = _StubTarget({"slots_free": 0, "queue_depth": 9,
+                         "est_slot_free_s": None})
+    assert ServingFrontend(blind).submit(
+        [1], options=SubmitOptions(slo=SLOSpec(ttft_s=0.001))).accepted
+    # no SLO attached: the gate never engages
+    assert ServingFrontend(_StubTarget(_BUSY)).submit([1]).accepted
+    # explicit bypass: the caller prefers late over never
+    assert ServingFrontend(_StubTarget(_BUSY)).submit(
+        [1], options=SubmitOptions(slo=SLOSpec(ttft_s=0.001),
+                                   admit_despite_slo=True)).accepted
+
+
+def test_rejected_stream_yields_no_events():
+    fe = ServingFrontend(_StubTarget(_BUSY))
+    stream = fe.submit_stream([1, 2], options=SubmitOptions(
+        slo=SLOSpec(ttft_s=0.001)))
+    assert not stream.result.accepted
+    assert list(stream) == []
+    assert fe.open_streams() == []
+
+
+def test_submit_stream_requires_journaled_target(model):
+    """The journal IS the stream transport: an unjournaled engine can serve
+    plain submits but must refuse submit_stream loudly."""
+    module, params = model
+    engine = ServingEngine(module, params, max_concurrency=2,
+                           prompt_buckets=(16, 32), max_queue=8)
+    fe = ServingFrontend(engine)
+    with pytest.raises(ValueError, match="journal"):
+        fe.submit_stream([1, 2, 3])
+    assert engine.scheduler.queue_depth == 1  # the plain submit went through
+    engine.abort_all()
+
+
+# ----------------------------------------------- streaming parity (engine)
+@_drives_engine
+def test_single_class_stream_bit_exact_vs_fifo_completed(model, tmp_path):
+    """The acceptance contract: greedy requests streamed through a
+    FairScheduler frontend deliver bit-for-bit what the plain FIFO
+    completed-output path emits — which both must equal solo `generate`."""
+    module, params = model
+    prompts = _prompts(3, [5, 9, 12, 7, 3])
+    n_new = 8
+
+    fifo_engine = ServingEngine(module, params, max_concurrency=2,
+                                prompt_buckets=(16, 32), max_queue=32)
+    fifo_out = {}
+    rids = [fifo_engine.submit(Request(list(p), SamplingParams(
+        max_new_tokens=n_new))).request_id for p in prompts]
+    while fifo_engine.has_work:
+        for o in fifo_engine.step():
+            fifo_out[o.request_id] = o
+
+    fair_engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(16, 32),
+        max_queue=32, scheduler=FairScheduler(),
+        journal=str(tmp_path / "journal.bin"))
+    fe = ServingFrontend(fair_engine)
+    streams = [fe.submit_stream(list(p), SamplingParams(max_new_tokens=n_new))
+               for p in prompts]
+    assert all(s.result.accepted for s in streams)
+    events = {s.request_id: [] for s in streams}
+    while fair_engine.has_work or fe.open_streams():
+        fair_engine.step()
+        for ev in fe.pump():
+            events[ev.request_id].append(ev)
+
+    for i, stream in enumerate(streams):
+        assert stream.finished and stream.finish_reason == FINISH_LENGTH
+        ref = fifo_out[rids[i]]
+        assert ref.finish_reason == FINISH_LENGTH
+        assert stream.delivered == ref.tokens, f"stream {i} diverged"
+        assert stream.delivered == _solo(module, params, prompts[i], n_new)
+        evs = events[stream.request_id]
+        assert evs[0].kind == EV_STREAM_FIRST
+        assert evs[-1].kind == EV_STREAM_FINISH
+        # exactly-once: event suffixes concatenate to delivered, n monotone
+        flat = [t for ev in evs for t in ev.tokens]
+        assert flat == stream.delivered
+        ns = [ev.n for ev in evs]
+        assert ns == sorted(ns)
+    m = fair_engine.metrics.snapshot()
+    assert m["serving/streams_opened"] == len(prompts)
+    assert m["serving/streams_finished"] == len(prompts)
+
+
+@_drives_engine
+def test_mixed_class_fairness_all_finish_bit_exact(model, tmp_path):
+    """Mixed classes/tenants reorder SERVICE, never tokens: every stream —
+    including the lowest class under higher-priority pressure — finishes
+    bit-for-bit vs solo, and the low class is not starved out."""
+    module, params = model
+    prompts = _prompts(5, [5, 9, 12, 7, 3, 10])
+    n_new = 6
+    engine = ServingEngine(
+        module, params, max_concurrency=2, prompt_buckets=(16, 32),
+        max_queue=32,
+        scheduler=FairScheduler(quantum_tokens=16, starvation_bound=2),
+        journal=str(tmp_path / "journal.bin"))
+    fe = ServingFrontend(engine)
+    streams = [fe.submit_stream(
+        list(p), SamplingParams(max_new_tokens=n_new),
+        SubmitOptions(priority=i % 2, tenant=f"t{i % 3}"))
+        for i, p in enumerate(prompts)]
+    assert all(s.result.accepted for s in streams)
+    while engine.has_work or fe.open_streams():
+        engine.step()
+        fe.pump()
+    for i, stream in enumerate(streams):
+        assert stream.finished and stream.finish_reason == FINISH_LENGTH
+        assert stream.delivered == _solo(module, params, prompts[i], n_new), (
+            f"stream {i} diverged under fair scheduling")
+
+
+@_drives_engine
+def test_chaos_stream_kill_byte_identical():
+    """The crash leg of the streaming contract, via the chaos harness:
+    SIGKILL mid-stream, resume, re-attach every consumer at its delivered
+    frontier — zero divergent streams, no duplicated events."""
+    import tools.chaos_serve as chaos_serve
+
+    summary = chaos_serve.run_stream_kill(n_requests=6, concurrency=2,
+                                          seed=3, timeout_s=300.0)
+    assert summary["value"] == 0, summary
+    detail = summary["detail"]
+    assert detail["byte_identical_streams"] == 6
+    assert len(detail["mid_stream_at_kill"]) >= 1
+    assert detail["steady_state"]["blocks_pinned"] == 0

@@ -94,6 +94,16 @@ Env knobs:
                         forced. Default: unsharded (single device)
   CHAOS_SCENARIO        "sigterm" or "sigkill" runs the kill-mid-decode
                         crash scenario instead of the fault-injection replay;
+                        "stream_kill" runs the STREAMING crash scenario
+                        (`serving/frontend.py`, docs/serving.md "Front
+                        door"): the parent tails the child's journal as a
+                        streaming consumer, SIGKILLs the child mid-stream,
+                        resumes a fresh engine and re-attaches every stream
+                        at its exact pre-crash frontier with
+                        `ServingFrontend.resume_stream` — asserting every
+                        resumed stream byte-identical to solo generate with
+                        no duplicated events (works under CHAOS_SPEC /
+                        CHAOS_SYNC_TOKENS / CHAOS_PAGED too);
                         "hang" or "storm" runs the SELF-HEALING scenario
                         (`serving/supervisor.py`): a wedged mid-decode
                         dispatch / a NaN quarantine storm that the engine
@@ -817,6 +827,203 @@ def run_replica_kill(
     }
 
 
+def run_stream_kill(
+    n_requests: int = 12,
+    concurrency: int = 2,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    prefix_cache: bool = True,
+    prefix_blocks: int = 6,
+    timeout_s: float = 240.0,
+    workdir: str | None = None,
+    paged: bool = False,
+    sync_tokens: int = 1,
+    speculation: int = 0,
+) -> dict:
+    """Streaming crash scenario (``CHAOS_SCENARIO=stream_kill``): a STREAMING
+    consumer tails the child's journal while the child serves, the child is
+    SIGKILLed mid-stream (>= 1 stream with delivered tokens and no FINISH on
+    disk), and the parent resumes a fresh engine from the journal with
+    `ServingFrontend.resume_stream` re-attached at each consumer's exact
+    pre-crash frontier. Asserts the exactly-once streaming contract across
+    the crash: every resumed stream's pre-crash prefix + post-crash events is
+    BYTE-IDENTICAL to solo generate, no token is delivered twice (the
+    re-decoded overlap is verified against the frontier — a divergence raises
+    `StreamStall`), and no events are duplicated (each stream's cumulative
+    ``n`` is strictly increasing). Works under ``CHAOS_SPEC`` speculation and
+    ``CHAOS_SYNC_TOKENS`` multi-token scan too; return the summary dict
+    (importable — tests/test_frontend.py runs it)."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        PrefixCacheConfig,
+        RequestJournal,
+        ServingEngine,
+        ServingFrontend,
+    )
+    from accelerate_tpu.serving.frontend import _JournalTailer
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_stream_")
+    journal = os.path.join(workdir, "requests.journal")
+    env = dict(
+        os.environ,
+        CHAOS_CRASH_CHILD="1", CHAOS_JOURNAL=journal,
+        CHAOS_SNAPSHOT=os.path.join(workdir, "unused.snap"),
+        CHAOS_SCENARIO="stream_kill", CHAOS_REQUESTS=str(n_requests),
+        CHAOS_CONCURRENCY=str(concurrency), CHAOS_SEED=str(seed),
+        CHAOS_DEPTH=str(pipeline_depth), CHAOS_PREFIX=str(int(prefix_cache)),
+        CHAOS_PREFIX_BLOCKS=str(prefix_blocks),
+        CHAOS_PAGED=str(int(paged)),
+        CHAOS_SYNC_TOKENS=str(sync_tokens),
+        CHAOS_SPEC=str(speculation),
+        JAX_PLATFORMS="cpu",
+    )
+    t0 = time.perf_counter()
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    # the parent IS the streaming consumer: tail the child's journal exactly
+    # the way a `TokenStream` does, recording each request's delivered
+    # frontier. Kill only once >= 1 stream is provably mid-flight (tokens
+    # delivered, no FINISH on disk).
+    tailer = _JournalTailer(journal)
+    pre: dict[int, list[int]] = {}
+    rc = None
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and child.poll() is None:
+            tailer.poll()
+            mid = [rid for rid, toks in tailer.tokens.items()
+                   if toks and rid not in tailer.finishes]
+            if mid:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"child never reached mid-stream (rc={child.poll()})")
+        pre = {rid: list(toks) for rid, toks in tailer.tokens.items()}
+        child.send_signal(_signal.SIGKILL)
+        rc = child.wait(timeout=timeout_s)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    assert rc == -_signal.SIGKILL, f"stream_kill child exited {rc}"
+    mid_stream = sorted(rid for rid in mid)
+
+    scan = RequestJournal.scan(journal)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    engine = ServingEngine(
+        module, params, max_concurrency=concurrency,
+        prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+        pipeline_depth=pipeline_depth,
+        prefix_cache=(PrefixCacheConfig(num_blocks=prefix_blocks)
+                      if prefix_cache else False),
+        journal=journal,
+        paged_kv=paged,
+        tokens_per_sync=sync_tokens,
+        speculation=speculation or None,
+    )
+    report = engine.resume(journal)
+    frontend = ServingFrontend(engine)
+    streams = {rid: frontend.resume_stream(rid, delivered=list(pre.get(rid, [])))
+               for rid in sorted(scan.submits)}
+    events: dict[int, list] = {rid: [] for rid in streams}
+    stalls = 0
+    while engine.has_work or frontend.open_streams():
+        if engine.has_work:
+            engine.step()
+            stalls = 0
+        else:
+            stalls += 1
+            assert stalls < 1000, (
+                f"streams never finished after the drain: "
+                f"{[s.request_id for s in frontend.open_streams()]}")
+        for ev in frontend.pump():
+            events[ev.request_id].append(ev)
+
+    # exactly-once across the crash, stream by stream
+    divergent = []
+    duplicated = []
+    for rid, stream in streams.items():
+        assert stream.finished, f"stream {rid} never saw a FINISH record"
+        prefix = pre.get(rid, [])
+        # the pre-crash frontier survived verbatim (TokenStream verifies the
+        # re-journaled overlap internally — a divergence would have raised)
+        assert stream.delivered[:len(prefix)] == prefix, rid
+        # no duplicated events: token events carry the post-crash suffix
+        # exactly once, with strictly increasing cumulative n
+        suffix = []
+        last_n = len(prefix)
+        for ev in events[rid]:
+            if ev.tokens:
+                suffix.extend(ev.tokens)
+            if ev.n < last_n:
+                duplicated.append(rid)
+            last_n = max(last_n, ev.n)
+        if prefix + suffix != stream.delivered:
+            duplicated.append(rid)
+        if stream.finish_reason in (FINISH_EOS, FINISH_LENGTH):
+            rec = scan.submits[rid]
+            sp = rec["params"]
+            ids = jnp.asarray(np.asarray(rec["prompt"], np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=sp["max_new_tokens"],
+                temperature=sp["temperature"], top_k=sp["top_k"],
+                rng=jax.random.key(sp["seed"]),
+            )
+            if stream.delivered != np.asarray(ref)[0].tolist():
+                divergent.append(rid)
+    assert not duplicated, f"duplicated stream events across crash: {duplicated}"
+    assert not divergent, (
+        f"resumed streams not byte-identical to solo generate: {divergent}")
+    steady = _assert_steady_state(engine)
+
+    return {
+        "metric": "chaos_serve_stream_kill_divergent_streams",
+        "value": len(divergent),
+        "unit": "streams",
+        "detail": {
+            "scenario": "stream_kill",
+            "child_exit_code": rc,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "prefix_cache": bool(prefix_cache),
+            "paged_kv": bool(paged),
+            "tokens_per_sync": sync_tokens,
+            "speculation": speculation,
+            "streams": len(streams),
+            "mid_stream_at_kill": mid_stream,
+            "pre_crash_tokens": {str(r): len(t) for r, t in pre.items()},
+            "finished_pre_crash": len(scan.finishes),
+            "resumed_mid_stream": len(report.resumed),
+            "restored_queued": len(report.restored),
+            "replayed_tokens": engine.metrics.replayed_tokens.value,
+            "journal_records": scan.records,
+            "truncated_tail_bytes": scan.truncated_tail_bytes,
+            "byte_identical_streams": len(streams) - len(divergent),
+            "steady_state": steady,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
 def _crash_child() -> None:
     """Child half of the crash scenarios: serve the trace with a journal (and,
     under sigterm, a drain-or-snapshot preemption handler) until killed."""
@@ -1106,6 +1313,21 @@ def main() -> None:
             stall_timeout_s=float(os.environ.get("CHAOS_STALL_TIMEOUT", 0.15)),
             verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
             trace_path=os.environ.get("CHAOS_TRACE") or None,
+        )
+        print(json.dumps(summary), flush=True)
+        return
+    if os.environ.get("CHAOS_SCENARIO", "").lower() == "stream_kill":
+        summary = run_stream_kill(
+            n_requests=_env_int("CHAOS_REQUESTS", 12),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            prefix_cache=bool(_env_int("CHAOS_PREFIX", 1)),
+            prefix_blocks=_env_int("CHAOS_PREFIX_BLOCKS", 6),
+            workdir=os.environ.get("CHAOS_WORKDIR") or None,
+            paged=bool(_env_int("CHAOS_PAGED", 0)),
+            sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
+            speculation=_env_int("CHAOS_SPEC", 0),
         )
         print(json.dumps(summary), flush=True)
         return

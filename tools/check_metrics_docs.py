@@ -36,8 +36,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _STAT_SUFFIXES = frozenset(
     {"count", "mean", "min", "max", "p50", "p90", "p99", "sum"})
 # families whose key tails are request-dependent (SLO class names, compile
-# cache keys): documented as a prefix, not per-member
-_DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/")
+# cache keys, scheduler priority classes): documented as a prefix, not
+# per-member
+_DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/", "serving/class/")
 _DEFAULT_DOC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs", "observability.md")

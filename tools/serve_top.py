@@ -6,8 +6,13 @@ or ``BENCH_SERVE_TELEMETRY=path`` on `benchmarks/bench_serving.py`) and
 renders the latest point as a top(1)-style screen: slot/queue occupancy
 bars, decode rate vs goodput, latency percentiles, speculation accept
 telemetry (when the engine drafts), KV slot-pool and prefix block-pool byte
-accounting, and the capacity headroom estimate — plus a sparkline of the
-decode rate over the trailing window.
+accounting, the capacity headroom estimate, and the front-door view
+(`docs/serving.md` "Front door": open token streams with delivery lag, one
+row per scheduler priority class with queue depth / starvation / predictive
+shed counts, per-SLO-class attainment) — plus a sparkline of the decode rate
+over the trailing window. Cluster points render one row per replica with a
+stream-lag column (the delivery lag of streams tailing that replica's
+journal).
 
 One-shot by default (render the latest point and exit); ``--watch N``
 re-reads the file every N seconds until interrupted, like ``top``. All
@@ -33,6 +38,11 @@ _SPARK = " .:-=+*#%@"
 # per-replica gauge namespace a ServingCluster point carries
 # (serving/telemetry.py `replica<i>/...` keys)
 _REPLICA_KEY = re.compile(r"^replica(\d+)/(.+)$")
+
+# per-priority-class scheduler gauges (`FairScheduler.class_gauges`; class -1
+# is the watchdog-requeue front deque) and per-SLO-class attainment
+_CLASS_KEY = re.compile(r"^serving/class/(-?\d+)/(.+)$")
+_SLO_ATTAIN = re.compile(r"^serving/slo/([^/]+)/attainment$")
 
 
 def load_points(path: str) -> list[dict]:
@@ -123,6 +133,49 @@ def render(point: dict, history: list[dict] | None = None,
     if ttft_p50 is not None:
         lines.append(f"ttft   p50 {1e3 * ttft_p50:.1f} ms, "
                      f"p99 {1e3 * g('serving/ttft_s/p99', 0.0):.1f} ms")
+
+    # front-door gauges (serving/frontend.py, scheduler.py FairScheduler —
+    # docs/serving.md "Front door"): open streams + delivery lag, one row
+    # per scheduler priority class, per-SLO-class attainment, and the
+    # predictive-admission shed count (distinct from brownout shed)
+    opened = g("serving/streams_opened")
+    if opened:
+        lag = g("serving/stream_lag_s/p50")
+        sttft = g("serving/streamed_ttft_s/p50")
+        extra = ""
+        if sttft is not None:
+            extra += f", streamed ttft p50 {1e3 * sttft:.1f} ms"
+        if lag is not None:
+            extra += f", lag p50 {1e3 * lag:.1f} ms"
+        lines.append(
+            f"stream {int(opened) - int(g('serving/streams_finished', 0))} "
+            f"open ({int(opened)} opened, "
+            f"{int(g('serving/stream_events', 0))} events{extra})")
+    classes: dict[int, dict] = {}
+    for k, v in point.items():
+        m = _CLASS_KEY.match(k)
+        if m is not None:
+            classes.setdefault(int(m.group(1)), {})[m.group(2)] = v
+    shed_predicted = int(g("serving/requests_shed_predicted", 0) or 0)
+    if classes or shed_predicted:
+        lines.append(f"class  {len(classes)} scheduler class(es), "
+                     f"predictive shed {shed_predicted}")
+        for p in sorted(classes, reverse=True):
+            c = classes[p].get
+            label = "requeue" if p < 0 else f"p{p}"
+            starved = int(c("starved", 0) or 0)
+            starve_txt = f", {starved} starved" if starved else ""
+            lines.append(
+                f"  {label:<7} queue {int(c('queue_depth', 0) or 0)} "
+                f"({int(c('tenants', 0) or 0)} tenant(s){starve_txt}), "
+                f"shed {int(c('shed', 0) or 0)}")
+    slo_classes = {m.group(1): point[k] for k in point
+                   if (m := _SLO_ATTAIN.match(k)) is not None}
+    if slo_classes:
+        lines.append("slo    " + ", ".join(
+            f"{name} {frac:.1%} "
+            f"({int(point.get(f'serving/slo/{name}/requests', 0))} req)"
+            for name, frac in sorted(slo_classes.items())))
 
     if g("serving/spec_forwards"):
         proposed = int(g("serving/spec_proposed", 0))
@@ -221,10 +274,16 @@ def render(point: dict, history: list[dict] | None = None,
             occ = f"{int(active)}/{int(total)} slots" if total else "slots ?"
             level = int(r("cluster/brownout_level", 0))
             state = f"BROWNOUT L{level}" if level else "ok"
+            # stream-lag column: journal-append -> caller delivery for the
+            # streams tailing THIS replica's journal (the frontend accounts
+            # on the replica it reads, so replicas without streams show "-")
+            lag = r("serving/stream_lag_s/p50")
+            lag_txt = f"{1e3 * lag:.1f} ms" if lag is not None else "-"
             lines.append(
                 f"  r{i} [{r('cluster/role', '?'):<7}] {state:<12}"
                 f"{r('serving/tokens_per_sec', 0.0):>8.1f} tok/s  {occ}, "
                 f"queue {int(r('serving/mem/queue_depth', 0) or 0)}, "
+                f"lag {lag_txt}, "
                 f"restarts {int(r('cluster/restarts', 0))}")
     return "\n".join(lines)
 
