@@ -35,6 +35,7 @@ from .frontend import (
     predict_ttft,
 )
 from .journal import JournalError, JournalScan, RequestJournal
+from .kv_tier import KVTier, KVTierConfig, choose_wake
 from .metrics import Counter, Histogram, ServingMetrics
 from .prefix_cache import PrefixCache, PrefixCacheConfig
 from .request import (
@@ -92,6 +93,9 @@ __all__ = [
     "RequestJournal",
     "JournalScan",
     "JournalError",
+    "KVTier",
+    "KVTierConfig",
+    "choose_wake",
     "PrefixCache",
     "PrefixCacheConfig",
     "ServingMetrics",

@@ -67,6 +67,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..models.gpt2 import gpt2_sharding_rules
 from ..models.kv_cache import (
     BlockAllocator,
+    _is_index_leaf,
     gather_block_rows,
     make_cache,
     rewind_frontier,
@@ -88,6 +89,7 @@ from ..reliability.faults import ALL_SLOTS, active_injector
 from .anomaly import NULL_ANOMALY
 from .journal import MAGIC as JOURNAL_MAGIC
 from .journal import JournalScan, RequestJournal, request_record
+from .kv_tier import KVTier, KVTierConfig
 from .metrics import ServingMetrics
 from .prefix_cache import NO_MATCH, PrefixCache, PrefixCacheConfig, PrefixMatch
 from .request import (
@@ -336,6 +338,7 @@ class ServingEngine:
         speculation: Any = None,
         anomaly: Any = None,
         scheduler: Any = None,
+        kv_tier: KVTierConfig | bool | None = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -743,6 +746,26 @@ class ServingEngine:
         self._step_fn = self._build_step_fn()
         self._admit_fn = (self._build_paged_admit_fn() if self.paged
                           else self._build_admit_fn())
+        # host-RAM KV tier + request hibernation (serving/kv_tier.py,
+        # docs/serving.md "KV tiering & hibernation"): a host-memory block
+        # tier behind the paged pool, so concurrency outgrows device HBM.
+        # Default off — tier-off programs and host paths stay bit-for-bit.
+        self.kv_tier: KVTier | None = None
+        self._tier_wake_fn = None
+        if kv_tier:
+            if not self.paged:
+                raise ValueError(
+                    "kv_tier requires paged_kv — the host tier spills and "
+                    "restores pool blocks through the block tables")
+            if self.mesh is not None:
+                raise ValueError(
+                    "kv_tier does not support mesh-sharded serving yet")
+            tcfg = (kv_tier if isinstance(kv_tier, KVTierConfig)
+                    else KVTierConfig())
+            self.kv_tier = KVTier(self, tcfg)
+            if self.prefix_cache is not None:
+                self.prefix_cache.tier = self.kv_tier
+            self._tier_wake_fn = self._build_tier_wake_fn()
         # compile telemetry: every jitted serving program's first dispatch is
         # timed (the python call blocks through trace+compile; execution stays
         # async, so the first-call wall time is compile-dominated) under a
@@ -1423,6 +1446,162 @@ class ServingEngine:
                            row, row, row, row, row, row, row),
         )
 
+    def _build_tier_wake_fn(self):
+        """ONE jitted program for every host->device tier restore
+        (`serving/kv_tier.py`): scatter host block copies into the paged pool
+        at ``dest`` ids (sentinel entries drop) and rewrite one slot's entire
+        per-slot decode state — block-table row, frontier cursor, last token,
+        position, sampling params, rng chain, budget, finished=False.
+
+        The trie page-in path reuses the same compiled program by passing
+        ``slot = max_concurrency``: every per-slot ``.at[slot].set`` is then
+        out of bounds, and JAX scatter semantics DROP out-of-bounds updates —
+        only the pool-block writes land. One compile serves both paths."""
+
+        def wake_fn(cache, host_blocks, dest, slot, index, table_row,
+                    d_tables, token, pos, temp, topk, remaining, rng_row,
+                    d_tokens, d_pos, d_temps, d_topks, d_finished,
+                    d_remaining, rng_data):
+            def put(path, leaf, host_leaf):
+                if _is_index_leaf(path):
+                    # the paged cursor leaf is [max_concurrency]: restamp the
+                    # woken slot's append frontier (drops on the trie path)
+                    return leaf.at[slot].set(index.astype(leaf.dtype))
+                return leaf.at[dest].set(
+                    host_leaf.astype(leaf.dtype), mode="drop")
+
+            new_cache = jax.tree_util.tree_map_with_path(
+                put, cache, host_blocks)
+            d_tables = d_tables.at[slot].set(table_row)
+            d_tokens = d_tokens.at[slot].set(token)
+            d_pos = d_pos.at[slot].set(pos)
+            d_temps = d_temps.at[slot].set(temp)
+            d_topks = d_topks.at[slot].set(topk)
+            d_finished = d_finished.at[slot].set(False)
+            d_remaining = d_remaining.at[slot].set(remaining)
+            rng_data = rng_data.at[slot].set(rng_row)
+            return (new_cache, d_tables, d_tokens, d_pos, d_temps, d_topks,
+                    d_finished, d_remaining, rng_data)
+
+        return _shared_jit(self.module, "tier_wake",
+                           lambda: jax.jit(wake_fn, donate_argnums=(0,)))
+
+    def _tier_upload(self, dest: np.ndarray, host_tree: Any, *,
+                     slot: int | None = None, index: int = 0,
+                     table_row: np.ndarray | None = None, token: int = 0,
+                     pos: int = 0, temp: float = 0.0, topk: int = 0,
+                     remaining: int = 0, rng_row: np.ndarray | None = None
+                     ) -> None:
+        """Dispatch one ``tier_wake`` restore. Without ``slot`` this is a
+        trie page-in: the per-slot half of the program aims at the
+        out-of-bounds slot ``max_concurrency`` and drops, so only the pool
+        blocks named by ``dest`` change."""
+        if slot is None:
+            slot = self.max_concurrency
+        if table_row is None:
+            table_row = np.full(self._blocks_per_slot,
+                                self._allocator.num_blocks, np.int32)
+        if rng_row is None:
+            rng_row = np.asarray(jax.random.key_data(jax.random.key(0)))
+        (self._cache, self._d_tables, self._d_tokens, self._d_pos,
+         self._d_temps, self._d_topks, self._d_finished, self._d_remaining,
+         self._rng_data) = self._dispatch(
+            self._compile_key("tier_wake"), self._tier_wake_fn,
+            self._cache, host_tree, jnp.asarray(dest),
+            jnp.asarray(slot, jnp.int32), jnp.asarray(index, jnp.int32),
+            jnp.asarray(table_row), self._d_tables,
+            jnp.asarray(token, jnp.int32), jnp.asarray(pos, jnp.int32),
+            jnp.asarray(temp, jnp.float32), jnp.asarray(topk, jnp.int32),
+            jnp.asarray(remaining, jnp.int32), jnp.asarray(rng_row),
+            self._d_tokens, self._d_pos, self._d_temps, self._d_topks,
+            self._d_finished, self._d_remaining, self._rng_data,
+        )
+
+    def _wake_hibernated_upload(self, rec: Any) -> bool:
+        """Wake one hibernated stream by uploading its host KV blocks back
+        into freshly reserved pool blocks (`KVTier.try_wakes`' cheap path).
+        All or nothing: needs a free slot and the stream's FULL decode-extent
+        block reservation up front (mid-decode writes must never find the
+        pool empty — the same contract `_reserve_blocks` enforces), else
+        False and nothing changed. Decode resumes at position
+        ``prompt + emitted - 1`` with the rng chain fast-forwarded one split
+        per emitted token — the state M uninterrupted steps would hold, so
+        the continuation is bit-for-bit (tests/test_kv_tier.py parity)."""
+        tier = self.kv_tier
+        request = rec.request
+        if not self._free:
+            return False
+        bt = self._block_tokens
+        extent = FIFOScheduler.decode_extent(request, self.max_len)
+        need = -(-extent // bt)
+        ids = self._allocator.alloc(need)
+        if ids is None:
+            return False
+        if KVTier._crcs(rec.blocks.tree) != rec.blocks.crcs:
+            self._allocator.free(ids)
+            raise RuntimeError(
+                "host-tier content hash mismatch on hibernation wake "
+                "(host buffer corrupted)")
+        slot = self._free.popleft()
+        sentinel = self._allocator.num_blocks
+        table = np.full(self._blocks_per_slot, sentinel, np.int32)
+        table[:need] = ids
+        dest = np.full(self._blocks_per_slot, sentinel, np.int32)
+        dest[:rec.n_content] = table[:rec.n_content]
+        plen = len(request.prompt)
+        m = len(rec.tokens)
+        pos = plen + m - 1  # KV on host covers [0, pos - 1]; decode re-feeds
+        sp = request.params
+        remaining = min(int(sp.max_new_tokens), self.max_len - plen) - m
+        key = jax.random.key(sp.seed)
+        for _ in range(m):
+            key = jax.random.split(key)[0]
+        t0 = time.perf_counter()
+        self._tier_upload(
+            dest, tier._padded(rec.blocks, self._blocks_per_slot),
+            slot=slot, index=pos, table_row=table,
+            token=int(rec.tokens[-1]), pos=pos,
+            temp=float(sp.temperature), topk=int(sp.top_k or 0),
+            remaining=remaining,
+            rng_row=np.asarray(jax.random.key_data(key)),
+        )
+        wall = max(time.perf_counter() - t0, 1e-9)
+        now = time.perf_counter()
+        # host mirrors, à la _finish_admit — but the output resumes with the
+        # stream's full history and its ORIGINAL first-token time (wake is
+        # not a new admission; TTFT was already paid)
+        self._slot_gen[slot] += 1
+        self._slot_req[slot] = request
+        out = RequestOutput(
+            request_id=request.request_id, prompt_len=plen,
+            tokens=list(rec.tokens), finish_reason="",
+            arrival_time=request.arrival_time,
+        )
+        out.first_token_time = rec.first_token_time
+        self._slot_out[slot] = out
+        self._slot_logged[slot] = m  # journal was flushed at hibernate
+        self._active[slot] = True
+        slo = request.slo
+        self._slot_itl[slot] = (
+            [] if slo is not None and slo.itl_p99_s is not None else None)
+        self._slot_match[slot] = None
+        self._slot_hit[slot] = bool(rec.hit)
+        self._slot_priv[slot] = list(ids)
+        self._slot_table_host[slot] = table.copy()
+        self._slot_aliased[slot] = 0
+        self._slot_last_token_t[slot] = now
+        self.metrics.host_page_ins.inc(rec.n_content)
+        self.metrics.host_page_in_s.observe(wall)
+        tier._xfer.update(rec.blocks.nbytes / wall)
+        tier._record_page_events(rec.n_content)
+        if self.tracer.enabled:
+            self.tracer.emit(
+                EV_ADMIT, request.request_id, slot=slot,
+                gen=int(self._slot_gen[slot]), wake="upload", resumed=m,
+                depth=len(self._inflight),
+            )
+        return True
+
     def _prefill_len(self, request: Request) -> int:
         """Scheduler probe: prompt tokens admission would actually prefill for
         this request right now (its uncached suffix) — the grouping key for
@@ -1476,6 +1655,10 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
+        if self.kv_tier is not None and self.kv_tier.hibernated_count:
+            # hibernated streams are admitted work parked on the host tier —
+            # the step loop must keep running so the tier can wake them
+            return True
         return bool(self._active.any()) or self.scheduler.queue_depth > 0
 
     @property
@@ -1523,6 +1706,13 @@ class ServingEngine:
                 "fragmentation": base.get("fragmentation", 0.0),
             }.items():
                 stats[f"block_pool/{k}"] = v
+            if self.kv_tier is not None:
+                # host-tier ledger (docs/observability.md "host_tier"): host
+                # bytes/blocks are CURRENT occupancy, the rest are lifetime
+                # counters. The device invariant above is untouched by
+                # tiering — spilled blocks leave the device ledger entirely.
+                for k, v in self.kv_tier.memory_stats().items():
+                    stats[f"host_tier/{k}"] = v
         elif self.prefix_cache is not None:
             for k, v in self.prefix_cache.memory_stats().items():
                 stats[f"block_pool/{k}"] = v
@@ -1587,6 +1777,13 @@ class ServingEngine:
                 free * (self.max_len - 1),
                 blocks_free * self._block_tokens,
             )
+            if self.kv_tier is not None:
+                # host-backed capacity counts at a discounted rate: those
+                # tokens are servable, but only after a page-in that is
+                # slower than device-resident decode
+                capacity += int(self.kv_tier.cfg.headroom_discount
+                                * self.kv_tier.host_blocks
+                                * self._block_tokens)
         else:
             capacity = decode_remaining + free * (self.max_len - 1)
         rate = self.metrics.tokens_per_sec()
@@ -1617,6 +1814,8 @@ class ServingEngine:
             out["blocks_free"] = blocks_free
             out["blocks_per_request_est"] = (
                 priv / active if active else float(self._blocks_per_slot))
+            if self.kv_tier is not None:
+                out["host_blocks"] = self.kv_tier.host_blocks
         return out
 
     @property
@@ -1820,6 +2019,26 @@ class ServingEngine:
                 finish_reason=FINISH_ABORTED, arrival_time=queued.arrival_time,
                 finish_time=now,
             )
+        if self.kv_tier is not None:
+            rec = self.kv_tier.pop_record(request_id)
+            if rec is not None:
+                # hibernated: no slot, no device state — drop the host record
+                # and emit the terminal with the tokens parked at hibernation
+                self.metrics.requests_cancelled.inc()
+                if self.tracer.enabled:
+                    self.tracer.emit(EV_FINISH, request_id,
+                                     reason=FINISH_ABORTED,
+                                     tokens=len(rec.tokens),
+                                     depth=len(self._inflight),
+                                     **self._slo_trace_attrs(rec.request.slo))
+                if self.journal is not None:
+                    self.journal.log_finish(request_id, FINISH_ABORTED,
+                                            list(rec.tokens))
+                return RequestOutput(
+                    request_id=request_id, prompt_len=len(rec.request.prompt),
+                    tokens=list(rec.tokens), finish_reason=FINISH_ABORTED,
+                    arrival_time=rec.request.arrival_time, finish_time=now,
+                )
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.request_id == request_id:
                 finished: list[RequestOutput] = []
@@ -1893,6 +2112,25 @@ class ServingEngine:
                 finish_reason=reason,            # recovered prefix is output
                 arrival_time=req.arrival_time, finish_time=now,
             ))
+        if self.kv_tier is not None:
+            # hibernated streams abort after the queue, before active slots:
+            # they are admitted work without device state, so they carry
+            # their parked tokens like an active slot's partial output
+            for rec in self.kv_tier.records():
+                rid = rec.request.request_id
+                self.kv_tier.pop_record(rid)
+                self.metrics.requests_cancelled.inc()
+                if self.tracer.enabled:
+                    self.tracer.emit(EV_FINISH, rid, reason=reason,
+                                     tokens=len(rec.tokens), depth=0,
+                                     **self._slo_trace_attrs(rec.request.slo))
+                if self.journal is not None:
+                    self.journal.log_finish(rid, reason, list(rec.tokens))
+                aborted.append(RequestOutput(
+                    request_id=rid, prompt_len=len(rec.request.prompt),
+                    tokens=list(rec.tokens), finish_reason=reason,
+                    arrival_time=rec.request.arrival_time, finish_time=now,
+                ))
         for slot in np.flatnonzero(self._active):
             self.metrics.requests_cancelled.inc()
             self._retire(int(slot), reason, now, aborted)
@@ -1950,6 +2188,12 @@ class ServingEngine:
                 continue
             request, out = self._slot_req[slot], self._slot_out[slot]
             entries.append(self._entry(request, out.tokens, True, now))
+        if self.kv_tier is not None:
+            # hibernated streams snapshot like active slots (admitted, with
+            # their parked tokens): resume re-admits them mid-stream via the
+            # same continuation prefill a crashed slot gets
+            for rec in self.kv_tier.records():
+                entries.append(self._entry(rec.request, rec.tokens, True, now))
         for request in self.scheduler.snapshot_queue():
             entries.append(self._entry(
                 request, request.resume_tokens,
@@ -2488,6 +2732,12 @@ class ServingEngine:
                 tokens=[], finish_reason=f"rejected:{REJECT_DEADLINE}",
                 arrival_time=request.arrival_time, finish_time=now,
             ))
+        if self.kv_tier is not None:
+            # the per-step tier tick: thrash-guard hysteresis, low-water
+            # background spill, idle hibernation, and at most one wake —
+            # BEFORE the admission loop, so a prefill-mode wake lands at
+            # the queue front this very step
+            self.kv_tier.poll()
         while self._free:
             run_len = self.scheduler.peek_run(
                 min(len(self._free), self._admit_sizes[-1])
@@ -2724,6 +2974,12 @@ class ServingEngine:
             n_res = -(-extent // bt)  # ceil: the frontier block counts whole
             needs.append((aliased, max(0, n_res - aliased)))
         total = sum(n for _, n in needs)
+        if alloc.free_count < total and self.kv_tier is not None:
+            # spill-then-admit: page cold trie blocks (then, under pressure,
+            # whole cold slots) to host BEFORE falling back to discard
+            # eviction. A thrash-frozen tier makes this a no-op and the
+            # pre-tier reclaim/requeue behavior below takes over.
+            self.kv_tier.release_for(total)
         if alloc.free_count < total and self.prefix_cache is not None:
             self.prefix_cache.reclaim(total - alloc.free_count)
         if alloc.free_count < total:
@@ -2787,6 +3043,10 @@ class ServingEngine:
         avail = self._allocator.free_count
         if self.prefix_cache is not None:
             avail += int(self.prefix_cache.memory_stats()["blocks_evictable"])
+        if self.kv_tier is not None:
+            # blocks the spill-then-admit path could free (hibernatable cold
+            # slots above the residency floor); 0 while thrash-frozen
+            avail += self.kv_tier.pressure_headroom()
         n = 0
         for request in requests:
             need = self._blocks_needed(request)

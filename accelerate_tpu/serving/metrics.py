@@ -159,6 +159,17 @@ class ServingMetrics:
         self.prefix_tokens_reused = Counter()
         self.prefix_blocks_donated = Counter()
         self.prefix_evictions = Counter()
+        # host-RAM KV tier (serving/kv_tier.py — docs/serving.md "KV tiering
+        # & hibernation"): blocks paged device->host / host->device, whole
+        # requests hibernated and woken, thrash-guard freezes, and the
+        # per-transfer wall-second histograms the wake cost model feeds on
+        self.host_page_ins = Counter()
+        self.host_page_outs = Counter()
+        self.host_hibernated = Counter()
+        self.host_wakeups = Counter()
+        self.host_thrash_events = Counter()
+        self.host_page_in_s = Histogram()
+        self.host_page_out_s = Histogram()
         self.steps = Counter()
         # durability / recovery telemetry (serving/journal.py + engine
         # snapshot/resume — docs/reliability.md "Serving recovery"): journal
@@ -422,6 +433,11 @@ class ServingMetrics:
             "serving/prefix_tokens_reused": self.prefix_tokens_reused.value,
             "serving/prefix_blocks_donated": self.prefix_blocks_donated.value,
             "serving/prefix_evictions": self.prefix_evictions.value,
+            "serving/host_tier/page_ins": self.host_page_ins.value,
+            "serving/host_tier/page_outs": self.host_page_outs.value,
+            "serving/host_tier/hibernated": self.host_hibernated.value,
+            "serving/host_tier/wakeups": self.host_wakeups.value,
+            "serving/host_tier/thrash_events": self.host_thrash_events.value,
             "serving/steps": self.steps.value,
             "serving/journal_records": self.journal_records.value,
             "serving/journal_bytes": self.journal_bytes.value,
@@ -474,6 +490,8 @@ class ServingMetrics:
             ("inter_token_s", self.inter_token_s),
             ("request_latency_s", self.request_latency_s),
             ("host_blocked_s", self.host_blocked_s),
+            ("host_tier/page_in_s", self.host_page_in_s),
+            ("host_tier/page_out_s", self.host_page_out_s),
             ("queue_depth", self.queue_depth),
             ("slot_occupancy", self.slot_occupancy),
             ("dispatch_depth", self.dispatch_depth),

@@ -128,6 +128,11 @@ class PrefixCache:
         self.metrics = metrics
         self._root = _TrieNode((), None, -1)
         self._tick = 0
+        # host-RAM tier hook (`serving/kv_tier.py`, paged mode only): when
+        # set, spilled trie nodes (``block_id is None`` — bytes live in the
+        # tier's host map) stay hit-able: `acquire` pages them back in
+        # instead of recomputing prefill, `adopt` revives them for free
+        self.tier = None
         # ``allocator`` (a `models.kv_cache.BlockAllocator`) switches the trie
         # to PAGED mode (`docs/serving.md` "Paged KV"): the engine's paged KV
         # cache IS the pool, so this class owns no device state at all —
@@ -188,6 +193,11 @@ class PrefixCache:
         each) so eviction cannot reclaim blocks an in-flight request is
         copying from / logically depends on. Pair with `release`."""
         path = self._walk(prompt)
+        if self.tier is not None:
+            # page spilled blocks back to device; a failed page-in (pool
+            # exhausted, thrash guard frozen) truncates the match — the
+            # caller pins only what is actually device-backed
+            path = self.tier.ensure_resident(path)
         for node in path:
             node.ref += 1
             self._touch(node)
@@ -281,7 +291,13 @@ class PrefixCache:
                 node.children[key] = child
                 new += 1
             elif j >= owned_from:
-                self.allocator.free([int(block_ids[j])])
+                if child.block_id is None and self.tier is not None:
+                    # the retiring slot just rewrote this spilled block's
+                    # exact bytes on device: adopt the fresh copy and drop
+                    # the host buffer — a free page-in
+                    self.tier.revive(child, int(block_ids[j]))
+                else:
+                    self.allocator.free([int(block_ids[j])])
             self._touch(child)
             node = child
         if new and self.metrics is not None:
@@ -320,7 +336,9 @@ class PrefixCache:
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            if node.children or node.ref > 0:
+            if node.children or node.ref > 0 or node.block_id is None:
+                # spilled nodes (block_id None) hold no device block — their
+                # host copy is the tier's to drop, not this eviction's
                 continue
             if victim is None or node.last_used < victim.last_used:
                 victim = node
@@ -372,7 +390,7 @@ class PrefixCache:
             return 0
         return tree_nbytes(self.pool)
 
-    def memory_stats(self) -> dict[str, int | float]:
+    def memory_stats(self) -> dict[str, Any]:
         """Host-side occupancy gauges for the telemetry exporter
         (`serving/telemetry.py`, `docs/observability.md`). One trie walk, no
         device work. Resident blocks split three ways:
@@ -386,19 +404,28 @@ class PrefixCache:
           is stranded / resident (0.0 when the trie is empty) — the
           ROADMAP's paged-KV argument wants this number measured, not
           assumed.
+
+        With a host tier attached, spilled nodes (``block_id is None``) are
+        counted in the ``host_tier`` sub-dict instead of any device bucket:
+        ``blocks_resident`` is device-backed occupancy only, so the device
+        conservation ``free + resident + private == total`` keeps holding
+        through every spill/page-in transition.
         """
-        pinned = evictable = resident = 0
+        pinned = evictable = resident = spilled = 0
         stack = list(self._root.children.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
+            if node.block_id is None:
+                spilled += 1
+                continue
             resident += 1
             if node.ref > 0:
                 pinned += 1
             elif not node.children:
                 evictable += 1
         stranded = resident - pinned - evictable
-        return {
+        out: dict[str, Any] = {
             "pool_bytes": self.pool_nbytes,
             "blocks_total": self.num_blocks,
             "blocks_free": self.blocks_free,
@@ -408,6 +435,12 @@ class PrefixCache:
             "blocks_stranded": stranded,
             "fragmentation": stranded / resident if resident else 0.0,
         }
+        if self.tier is not None:
+            out["host_tier"] = {
+                "blocks": spilled,
+                "bytes": spilled * self.tier.block_bytes,
+            }
+        return out
 
 
 def cache_batch_size(cache: Any) -> int:

@@ -95,6 +95,12 @@ def pytest_configure(config):
         "routing, journal-backed migration — docs/serving.md \"Multi-replica "
         "serving\") — run standalone with `pytest -m cluster`",
     )
+    config.addinivalue_line(
+        "markers",
+        "tier: host-RAM KV tier tests (engine ``kv_tier=``, block spill / "
+        "request hibernation / wake cost model — docs/serving.md \"KV "
+        "tiering & hibernation\") — run standalone with `pytest -m tier`",
+    )
 
 
 @pytest.fixture

@@ -24,6 +24,8 @@ from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 from accelerate_tpu.models.kv_cache import tree_bytes_by_dtype, tree_nbytes
 from accelerate_tpu.serving import (
     NULL_TELEMETRY,
+    KVTierConfig,
+    PagedKVConfig,
     PrefixCacheConfig,
     Request,
     SamplingParams,
@@ -97,26 +99,38 @@ def test_pool_bytes_match_nbytes_across_dtypes(kind):
 
 
 # -------------------------------------------------- occupancy gauge parity
+@pytest.mark.parametrize("tier", [False, True], ids=["plain", "tier"])
 @pytest.mark.parametrize("depth", [1, 2, 4])
 @pytest.mark.parametrize("admit", [1, 4])
-def test_occupancy_gauges_consistent_across_matrix(model, depth, admit):
+def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
     """At every pipeline-depth × admit-batch cell (the same matrix the
     parity tests run), the occupancy gauges stay self-consistent through
-    admit, retire, and LRU eviction, and settle to a clean steady state."""
+    admit, retire, and LRU eviction, and settle to a clean steady state.
+    The ``tier`` cells run the paged pool with the host KV tier attached
+    and additionally hold the cross-tier byte invariant (``host_tier/bytes
+    == blocks × block_bytes``, and the trie's spilled sub-ledger agrees
+    with the tier's) through spill-driven churn."""
     module, params = model
-    engine = ServingEngine(module, params, max_concurrency=3,
-                           prompt_buckets=(8, 32), max_queue=8,
-                           pipeline_depth=depth, admit_batch=admit,
-                           prefix_cache=PrefixCacheConfig(block_tokens=8,
-                                                          num_blocks=3))
+    kw = dict(max_concurrency=3, prompt_buckets=(8, 32), max_queue=8,
+              pipeline_depth=depth, admit_batch=admit)
+    if tier:
+        # 16 blocks is one full row — the minimum pool, so pressure is real
+        kw.update(prefix_cache=PrefixCacheConfig(block_tokens=8),
+                  paged_kv=PagedKVConfig(block_tokens=8, num_blocks=16),
+                  kv_tier=KVTierConfig(min_resident_slots=1,
+                                       low_water_blocks=2,
+                                       thrash_enter_events=10_000))
+    else:
+        kw.update(prefix_cache=PrefixCacheConfig(block_tokens=8, num_blocks=3))
+    engine = ServingEngine(module, params, **kw)
     prompts = _prompts(17, [20, 24, 22, 20, 26, 24])
     prompts[3] = list(prompts[0])  # duplicate → prefix hit after donation
     for p in prompts:
         assert engine.submit(Request(
             prompt=p, params=SamplingParams(max_new_tokens=4, temperature=0.0),
         )).accepted
-    while engine.has_work:
-        engine.step()
+
+    def check():
         mem = engine.memory_stats()
         head = engine.capacity_headroom()
         assert mem["slots_active"] + mem["slots_free"] == mem["slots_total"]
@@ -124,21 +138,47 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit):
         assert mem["queue_depth"] == engine.scheduler.queue_depth
         assert (mem["block_pool/blocks_free"]
                 + mem["block_pool/blocks_resident"]
+                + mem.get("block_pool/blocks_private", 0)
                 == mem["block_pool/blocks_total"])
         assert (mem["block_pool/blocks_pinned"]
                 + mem["block_pool/blocks_evictable"]
                 + mem["block_pool/blocks_stranded"]
                 == mem["block_pool/blocks_resident"])
-        assert (mem["block_pool/blocks_resident"]
+        pcs = engine.prefix_cache.memory_stats()
+        spilled = pcs.get("host_tier", {"blocks": 0})["blocks"]
+        assert (mem["block_pool/blocks_resident"] + spilled
                 == engine.prefix_cache.node_count())
         assert 0.0 <= mem["block_pool/fragmentation"] <= 1.0
+        if tier:
+            # cross-tier byte invariant, and the two host ledgers agree
+            assert (mem["host_tier/bytes"]
+                    == mem["host_tier/blocks"] * mem["host_tier/block_bytes"])
+            assert spilled == engine.kv_tier.trie_host_blocks
+            assert (pcs["host_tier"]["bytes"]
+                    == spilled * engine.kv_tier.block_bytes)
+            assert mem["host_tier/blocks"] >= spilled  # + hibernated content
         assert head["slots_free"] == mem["slots_free"]
         assert head["admissible_requests"] <= head["slots_free"]
         assert head["token_capacity_remaining"] >= 0
+        return mem
+
+    while engine.has_work:
+        engine.step()
+        check()
     mem = engine.memory_stats()
     assert mem["slots_active"] == 0 and mem["block_pool/blocks_pinned"] == 0
-    # the tiny pool saw real churn, or the scenario proves nothing
-    assert engine.metrics.prefix_evictions.value > 0
+    if tier:
+        assert mem["host_tier/hibernated"] == 0
+        # force a spill of the drained trie's donations: the invariant must
+        # hold with a genuinely non-zero host ledger, not just at 0 == 0
+        assert engine.kv_tier.page_out_trie(4) > 0
+        assert check()["host_tier/blocks"] > 0
+        # the tiny pool saw churn on at least one side of the tier boundary
+        assert (engine.metrics.prefix_evictions.value
+                + engine.metrics.host_page_outs.value) > 0
+    else:
+        # the tiny pool saw real churn, or the scenario proves nothing
+        assert engine.metrics.prefix_evictions.value > 0
 
 
 @pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])

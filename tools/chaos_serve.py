@@ -110,6 +110,12 @@ Env knobs:
                         SUPERVISOR — not this harness — must detect and
                         recover via automatic journal-backed restart, with
                         zero lost requests and zero token drift;
+                        "hibernate_kill" runs the HOST-TIER scenario
+                        (`serving/kv_tier.py`): SIGKILL a tier-on engine
+                        while requests are hibernated and blocks spilled to
+                        volatile host buffers, resume from the journal —
+                        zero lost, zero drift, host-tier gauges back to
+                        steady state, `journal_fsck` exit 0;
                         "replica_kill" runs the MULTI-REPLICA scenario
                         (`serving/cluster.py`): a `ServingCluster` of
                         CHAOS_REPLICAS zero-restart-budget replicas takes a
@@ -1077,6 +1083,230 @@ def _crash_child() -> None:
         time.sleep(0.05)
 
 
+def _hibernate_kill_child() -> None:
+    """Child half of the hibernate_kill scenario: a paged tier-on engine
+    serves the trace until the harness has FORCED the host tier into its
+    riskiest durable state — requests hibernated (slots released, KV only in
+    volatile host buffers) AND trie blocks spilled — then freezes there,
+    writes the marker, and waits for the parent's SIGKILL. Everything that
+    must survive is already on disk: hibernation flushes journal progress
+    before releasing blocks, host buffers are deliberately not durable."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.serving import (
+        KVTierConfig,
+        PagedKVConfig,
+        PrefixCacheConfig,
+        Request,
+        ServingEngine,
+    )
+
+    n = _env_int("CHAOS_REQUESTS", 12)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    trace = _trace(n, 1e9, _env_int("CHAOS_SEED", 0),
+                   int(module.config.vocab_size))
+    engine = ServingEngine(
+        module, params,
+        max_concurrency=_env_int("CHAOS_CONCURRENCY", 4),
+        prompt_buckets=BUCKETS, max_queue=n + 1,
+        pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+        prefix_cache=PrefixCacheConfig(block_tokens=16),
+        journal=os.environ["CHAOS_JOURNAL"],
+        paged_kv=PagedKVConfig(block_tokens=16, num_blocks=32),
+        kv_tier=KVTierConfig(),
+    )
+    for src in trace:
+        engine.submit(Request(src.prompt, src.params))
+    tier = engine.kv_tier
+    while engine.has_work:
+        engine.step()
+        for s in range(engine.max_concurrency):
+            if tier.hibernated_count >= 2:
+                break
+            if (engine._active[s] and engine._slot_out[s] is not None
+                    and engine._slot_out[s].tokens):
+                tier.hibernate_slot(s)
+        tier.page_out_trie(4)
+        if tier.hibernated_count >= 2 and tier.trie_host_blocks >= 1:
+            break
+    with open(os.environ["CHAOS_MARKER"] + ".tmp", "w") as f:
+        json.dump(tier.memory_stats(), f)
+    os.replace(os.environ["CHAOS_MARKER"] + ".tmp", os.environ["CHAOS_MARKER"])
+    # hold the hibernated + spilled state so the parent's SIGKILL lands on it
+    while True:
+        time.sleep(0.05)
+
+
+def run_hibernate_kill(
+    n_requests: int = 12,
+    concurrency: int = 4,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    timeout_s: float = 240.0,
+    workdir: str | None = None,
+    verify_parity: bool = True,
+) -> dict:
+    """SIGKILL a child engine WHILE requests are hibernated and blocks are
+    spilled to (volatile) host buffers, resume a fresh tier-on engine from
+    the journal, and assert zero lost requests, zero token drift, host-tier
+    gauges back to steady state, and `journal_fsck` exit 0. The durability
+    contract under test: the journal — not host RAM — is the durable tier
+    (`docs/serving.md` "KV tiering & hibernation")."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        KVTierConfig,
+        PagedKVConfig,
+        PrefixCacheConfig,
+        RequestJournal,
+        ServingEngine,
+    )
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_hibernate_")
+    journal = os.path.join(workdir, "requests.journal")
+    marker = os.path.join(workdir, "hibernated.marker")
+    env = dict(
+        os.environ,
+        CHAOS_HIBERNATE_CHILD="1", CHAOS_JOURNAL=journal,
+        CHAOS_MARKER=marker, CHAOS_REQUESTS=str(n_requests),
+        CHAOS_CONCURRENCY=str(concurrency), CHAOS_SEED=str(seed),
+        CHAOS_DEPTH=str(pipeline_depth),
+        JAX_PLATFORMS="cpu",
+    )
+    t0 = time.perf_counter()
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    rc = None
+    try:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and child.poll() is None:
+            if os.path.exists(marker):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"child never reached the hibernated+spilled state "
+                f"(rc={child.poll()})")
+        with open(marker) as f:
+            killed_gauges = json.load(f)
+        child.send_signal(_signal.SIGKILL)
+        rc = child.wait(timeout=timeout_s)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    assert rc == -_signal.SIGKILL, f"sigkill child exited {rc}"
+    assert killed_gauges["hibernated"] >= 2, killed_gauges
+    assert killed_gauges["blocks"] >= 1, killed_gauges
+
+    scan = RequestJournal.scan(journal)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    engine = ServingEngine(
+        module, params, max_concurrency=concurrency,
+        prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+        pipeline_depth=pipeline_depth,
+        prefix_cache=PrefixCacheConfig(block_tokens=16),
+        journal=journal,
+        paged_kv=PagedKVConfig(block_tokens=16, num_blocks=32),
+        kv_tier=KVTierConfig(),
+    )
+    report = engine.resume(journal)
+    outcomes: dict[int, tuple[str, list[int]]] = {
+        rid: (reason, toks) for rid, (reason, toks) in scan.finishes.items()
+    }
+    for rid, out in report.completed.items():
+        outcomes[rid] = (out.finish_reason, out.tokens)
+    for out in report.expired:
+        outcomes[out.request_id] = (out.finish_reason, out.tokens)
+    while engine.has_work:
+        for out in engine.step():
+            outcomes[out.request_id] = (out.finish_reason, out.tokens)
+    lost = sorted(rid for rid in scan.submits if rid not in outcomes)
+    assert not lost, (
+        f"lost requests (journaled as accepted, no terminal outcome after "
+        f"hibernate_kill + resume): {lost}")
+    steady = _assert_steady_state(engine)
+    # the host tier itself must settle: nothing left parked or spilled, no
+    # thrash freeze — the drained engine's tier is indistinguishable from a
+    # fresh one except for its lifetime counters
+    mem = engine.memory_stats()
+    assert mem["host_tier/hibernated"] == 0, mem
+    assert mem["host_tier/blocks"] == 0 and mem["host_tier/bytes"] == 0, mem
+    assert mem["host_tier/spill_frozen"] == 0, mem
+
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, (reason, toks) in sorted(outcomes.items()):
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            rec = scan.submits[rid]
+            sp = rec["params"]
+            ids = jnp.asarray(np.asarray(rec["prompt"], np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=sp["max_new_tokens"],
+                temperature=sp["temperature"], top_k=sp["top_k"],
+                rng=jax.random.key(sp["seed"]),
+            )
+            checked += 1
+            if toks != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, (
+            f"token drift across hibernate_kill + resume: requests {drift}")
+
+    fsck = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "journal_fsck.py"), journal],
+        capture_output=True, text=True)
+    assert fsck.returncode == 0, f"journal_fsck failed: {fsck.stdout}"
+
+    return {
+        "metric": "chaos_serve_hibernate_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "scenario": "hibernate_kill",
+            "child_exit_code": rc,
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "killed_host_tier": killed_gauges,
+            "finished_pre_crash": len(scan.finishes),
+            "resumed_mid_stream": len(report.resumed),
+            "restored_queued": len(report.restored),
+            "expired_on_restore": len(report.expired),
+            "journal_records": scan.records,
+            "truncated_tail_bytes": scan.truncated_tail_bytes,
+            "downtime_s": round(report.downtime_s, 3),
+            "parity_checked": checked,
+            "parity_drift": len(drift),
+            "steady_state": steady,
+            "journal_fsck_exit": fsck.returncode,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
 def run_crash(
     scenario: str = "sigkill",
     n_requests: int = 12,
@@ -1286,8 +1516,22 @@ def run_crash(
 
 
 def main() -> None:
+    if os.environ.get("CHAOS_HIBERNATE_CHILD"):
+        _hibernate_kill_child()
+        return
     if os.environ.get("CHAOS_CRASH_CHILD"):
         _crash_child()
+        return
+    if os.environ.get("CHAOS_SCENARIO", "").lower() == "hibernate_kill":
+        summary = run_hibernate_kill(
+            n_requests=_env_int("CHAOS_REQUESTS", 12),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 4),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
+            workdir=os.environ.get("CHAOS_WORKDIR") or None,
+        )
+        print(json.dumps(summary), flush=True)
         return
     if os.environ.get("CHAOS_SCENARIO", "").lower() == "replica_kill":
         summary = run_replica_kill(

@@ -38,7 +38,8 @@ _STAT_SUFFIXES = frozenset(
 # families whose key tails are request-dependent (SLO class names, compile
 # cache keys, scheduler priority classes): documented as a prefix, not
 # per-member
-_DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/", "serving/class/")
+_DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/", "serving/class/",
+                     "serving/host_tier/")
 _DEFAULT_DOC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs", "observability.md")

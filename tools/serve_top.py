@@ -211,6 +211,36 @@ def render(point: dict, history: list[dict] | None = None,
             f"frag {g('serving/mem/block_pool/fragmentation', 0.0):.2f}, "
             f"pool {_human_bytes(g('serving/mem/block_pool/pool_bytes', 0))}")
 
+    # host-tier line (serving/kv_tier.py — docs/serving.md "KV tiering &
+    # hibernation"): present only on tier-enabled engines. Page traffic is
+    # shown as a rate over the trailing history when two stamped points
+    # carry the counters, as lifetime totals otherwise; a DEAD-style FROZEN
+    # marker flags the thrash guard holding further spill.
+    htb = g("serving/mem/host_tier/blocks")
+    if htb is not None:
+        rate_txt = (f"page in/out {int(g('serving/mem/host_tier/page_ins', 0))}"
+                    f"/{int(g('serving/mem/host_tier/page_outs', 0))} total")
+        if history and len(history) >= 2:
+            prev = next((p for p in reversed(history[:-1])
+                         if "serving/mem/host_tier/page_ins" in p
+                         and p.get("_ts") is not None), None)
+            dt = ((ts or 0) - prev["_ts"]) if prev is not None else 0
+            if prev is not None and dt > 0:
+                pin = (g("serving/mem/host_tier/page_ins", 0)
+                       - prev.get("serving/mem/host_tier/page_ins", 0)) / dt
+                pout = (g("serving/mem/host_tier/page_outs", 0)
+                        - prev.get("serving/mem/host_tier/page_outs", 0)) / dt
+                rate_txt = f"page in/out {pin:.1f}/{pout:.1f} blk/s"
+        state = ("SPILL FROZEN"
+                 if g("serving/mem/host_tier/spill_frozen", 0) else "ok")
+        lines.append(
+            f"host   [{state:<12}] "
+            f"{_human_bytes(g('serving/mem/host_tier/bytes', 0))} "
+            f"({int(htb)} blocks), "
+            f"{int(g('serving/mem/host_tier/hibernated', 0))} hibernated, "
+            f"{rate_txt}, "
+            f"{int(g('serving/mem/host_tier/thrash_events', 0))} thrash")
+
     adm = g("serving/headroom/admissible_requests")
     if adm is not None:
         exhaust = g("serving/headroom/seconds_to_exhaustion")
