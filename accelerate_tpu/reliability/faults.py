@@ -40,6 +40,7 @@ SCOPE_CHECKPOINT_RESTORE = "checkpoint.restore"
 SCOPE_SERVING_DECODE = "serving.decode"
 SCOPE_SERVING_DISPATCH = "serving.dispatch"
 SCOPE_PREEMPTION = "preemption"
+SCOPE_REPLICA_SPAWN = "cluster.replica_spawn"
 
 # fault kinds
 KIND_IO_ERROR = "io_error"

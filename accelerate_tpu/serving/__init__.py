@@ -12,12 +12,17 @@ from .anomaly import (
     AnomalyMonitor,
     NullAnomalyMonitor,
 )
+from .autoscaler import DETECTOR_THRASH, AutoscalerConfig, FleetAutoscaler
 from .cluster import (
     POLICY_PREFIX,
     POLICY_ROUND_ROBIN,
     ROLE_DECODE,
     ROLE_MIXED,
     ROLE_PREFILL,
+    STATE_DEAD,
+    STATE_DRAINING,
+    STATE_OK,
+    STATE_RETIRED,
     ClusterConfig,
     ReplicaHandle,
     ServingCluster,
@@ -78,6 +83,13 @@ __all__ = [
     "ServingCluster",
     "ClusterConfig",
     "ReplicaHandle",
+    "FleetAutoscaler",
+    "AutoscalerConfig",
+    "DETECTOR_THRASH",
+    "STATE_OK",
+    "STATE_DRAINING",
+    "STATE_DEAD",
+    "STATE_RETIRED",
     "ROLE_PREFILL",
     "ROLE_DECODE",
     "ROLE_MIXED",

@@ -74,8 +74,9 @@ from .request import (
     SamplingParams,
     SubmitResult,
 )
+from ..reliability import faults
 from .supervisor import EngineSupervisor, EngineUnhealthyError, SupervisorConfig
-from .trace import EV_MIGRATE, EV_ROUTE
+from .trace import EV_MIGRATE, EV_ROUTE, EV_SCALE
 
 # replica roles (routing policy field — see module docstring)
 ROLE_PREFILL = "prefill"
@@ -87,6 +88,17 @@ ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
 POLICY_PREFIX = "prefix"
 POLICY_ROUND_ROBIN = "round_robin"
 POLICIES = (POLICY_PREFIX, POLICY_ROUND_ROBIN)
+
+# replica lifecycle states (docs/reliability.md "Elastic fleet"): OK serves,
+# DRAINING is excluded from placement but still stepped until its in-flight
+# work finishes or journal-migrates, DEAD is a budget-exhausted supervisor
+# awaiting replacement, RETIRED is terminal — journal closed, index never
+# reused, the handle stays in ``replicas`` so positional lookups stay valid
+STATE_OK = "ok"
+STATE_DRAINING = "draining"
+STATE_DEAD = "dead"
+STATE_RETIRED = "retired"
+STATES = (STATE_OK, STATE_DRAINING, STATE_DEAD, STATE_RETIRED)
 
 _UNHEALTHY_REASON = f"rejected:{REJECT_UNHEALTHY}"
 
@@ -153,13 +165,20 @@ class _ClusterMetricsView:
         out = aggregate_snapshots(
             [r.metrics.snapshot() for r in cluster.replicas])
         out.update(cluster.router_stats())
+        if cluster.autoscaler is not None:
+            out.update(cluster.autoscaler.gauges())
         return out
 
 
 class ReplicaHandle:
-    """One supervised replica: its index, role, supervisor, and journal."""
+    """One supervised replica: its index, role, supervisor, journal, and
+    lifecycle position (module ``STATE_*`` constants). ``index`` is stable
+    and never reused across retire/replace — handles stay in
+    ``ServingCluster.replicas`` after retirement so ``replicas[i].index == i``
+    holds for the cluster's positional routing tables."""
 
-    __slots__ = ("index", "role", "supervisor", "journal_path", "metrics")
+    __slots__ = ("index", "role", "supervisor", "journal_path", "metrics",
+                 "draining", "retired", "migrated")
 
     def __init__(self, index: int, role: str, supervisor: EngineSupervisor,
                  journal_path: Path, metrics: ServingMetrics):
@@ -168,10 +187,31 @@ class ReplicaHandle:
         self.supervisor = supervisor
         self.journal_path = journal_path
         self.metrics = metrics
+        self.draining = False
+        self.retired = False
+        # whether this replica's journal backlog has already been migrated
+        # (step()'s death intercept or a force retire) — replace_replica must
+        # not re-run the migration and duplicate the resubmits
+        self.migrated = False
 
     @property
     def healthy(self) -> bool:
-        return not self.supervisor.unhealthy
+        return not self.retired and not self.supervisor.unhealthy
+
+    @property
+    def state(self) -> str:
+        if self.retired:
+            return STATE_RETIRED
+        if self.supervisor.unhealthy:
+            return STATE_DEAD
+        if self.draining:
+            return STATE_DRAINING
+        return STATE_OK
+
+    @property
+    def accepting(self) -> bool:
+        """Eligible for NEW placements: healthy and not mid-retire."""
+        return self.healthy and not self.draining
 
     @property
     def engine(self) -> Any:
@@ -226,6 +266,8 @@ class ServingCluster:
                              f"{replicas} replicas")
         self.workdir = Path(workdir)
         self._clock = clock
+        self._factory = engine_factory
+        self._supervisor_config = supervisor_config
         self._next_rid = 0
         self._rr = 0  # round-robin cursor
         # cluster rid <-> (replica index, engine rid); a migrated request
@@ -233,32 +275,152 @@ class ServingCluster:
         self._routes: dict[int, tuple[int, int]] = {}
         self._by_engine: dict[tuple[int, int], int] = {}
         self._delivered: set[int] = set()
+        # cluster-id outputs minted outside step() (replace_replica's
+        # migration deliverables) — drained by the next step()
+        self._pending_outputs: list[RequestOutput] = []
         self.migrations = 0  # replica deaths migrated
         self.migrated_requests = 0
+        self.retired_replicas = 0
+        self.replaced_replicas = 0
         self._routed = {POLICY_PREFIX: 0, POLICY_ROUND_ROBIN: 0}
         self._route_match_tokens = 0
+        # a FleetAutoscaler attaches itself here (serving/autoscaler.py);
+        # step() then runs one control evaluation per cluster step
+        self.autoscaler: Any = None
+        self._next_replica_index = 0
         self.replicas: list[ReplicaHandle] = []
         for i in range(replicas):
-            rep_dir = self.workdir / f"replica{i}"
-            rep_dir.mkdir(parents=True, exist_ok=True)
-            metrics = ServingMetrics()
-            sup = EngineSupervisor(
-                engine_factory,
-                rep_dir / "requests.journal",
-                config=supervisor_config,
-                metrics=metrics,
+            self.add_replica(
+                role=roles[i] if roles is not None else ROLE_MIXED,
                 tracer=tracers[i] if tracers is not None else None,
                 headroom_fn=(headroom_fns[i] if headroom_fns is not None
                              else None),
             )
-            self.replicas.append(ReplicaHandle(
-                i, roles[i] if roles is not None else ROLE_MIXED,
-                sup, rep_dir / "requests.journal", metrics))
         self.metrics = _ClusterMetricsView(self)
+
+    # -------------------------------------------------------- elastic fleet
+    def add_replica(self, role: str = ROLE_MIXED, *, tracer: Any = None,
+                    headroom_fn: Callable[[], dict[str, Any]] | None = None,
+                    ) -> ReplicaHandle:
+        """Spawn one fresh replica through the construction-time factory into
+        ``workdir/replica<i>/`` under the next never-reused index. The
+        ``cluster.replica_spawn`` fault point fires BEFORE any filesystem
+        effect, so a failed spawn leaves no debris and is safely retried
+        (`serving/autoscaler.py`'s seeded RetryPolicy). Same module/params
+        through the factory means `_SHARED_JITS` makes the spawn skip
+        recompilation — the cheap-scale-event contract."""
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        faults.fault_point(faults.SCOPE_REPLICA_SPAWN)
+        index = self._next_replica_index
+        rep_dir = self.workdir / f"replica{index}"
+        rep_dir.mkdir(parents=True, exist_ok=True)
+        metrics = ServingMetrics()
+        sup = EngineSupervisor(
+            self._factory,
+            rep_dir / "requests.journal",
+            config=self._supervisor_config,
+            metrics=metrics,
+            tracer=tracer,
+            headroom_fn=headroom_fn,
+        )
+        rep = ReplicaHandle(index, role, sup,
+                            rep_dir / "requests.journal", metrics)
+        self._next_replica_index += 1
+        self.replicas.append(rep)
+        return rep
+
+    def retire_replica(self, index: int, *, force: bool = False
+                       ) -> list[RequestOutput]:
+        """Begin (or, with ``force``, complete) the drain-and-retire
+        lifecycle on one replica. DRAINING excludes it from new placements
+        (`_eligible`) while `step()` keeps stepping its in-flight work; once
+        idle, `step()` finalizes it to RETIRED — journal closed, fsck-clean,
+        zero requests lost. ``force=True`` ends the grace period NOW: the
+        remaining in-flight work journal-migrates to peers (the PR-13
+        machinery, streams bit-exact) and the replica retires immediately.
+        Returns any cluster-id outputs the forced migration delivered."""
+        rep = self.replicas[index]
+        if rep.retired:
+            return []
+        sup = rep.supervisor
+        if sup.unhealthy:
+            # already failed loudly: the journal is closed and the backlog
+            # was migrated (step's intercept) or accounted — just finalize
+            self._finalize_retire(rep)
+            return []
+        rep.draining = True
+        sup.begin_drain()
+        if not force:
+            return []
+        if self.config.migrate:
+            produced = rep.engine.abort_all(reason=_UNHEALTHY_REASON)
+            produced = self._migrate(rep, produced)
+            rep.migrated = True
+        else:
+            produced = rep.engine.abort_all()
+        outputs = self._translate(rep.index, produced)
+        self._finalize_retire(rep)
+        return outputs
+
+    def replace_replica(self, index: int) -> ReplicaHandle:
+        """Replace a budget-exhausted (DEAD) replica: spawn a successor under
+        a fresh index, run the dead-journal migration into the fleet (unless
+        `step()`'s death intercept already did), and retire the dead handle.
+        Raises ``ValueError`` on a live or retired replica; spawn failures
+        (the ``cluster.replica_spawn`` fault point) propagate BEFORE any
+        state changes, so the caller may retry. Returns the successor."""
+        dead = self.replicas[index]
+        if dead.retired:
+            raise ValueError(f"replica {index} is already retired")
+        if not dead.supervisor.unhealthy:
+            raise ValueError(f"replica {index} is alive — use retire_replica")
+        successor = self.add_replica(role=dead.role)
+        if self.config.migrate and not dead.migrated:
+            # a replica that died outside step() (or with migrate deferred)
+            # still owes its backlog to the fleet; deliverables surface on
+            # the next step() via the successor's pending outputs path
+            self._pending_outputs.extend(
+                self._translate(dead.index, self._migrate(dead, [])))
+            dead.migrated = True
+        self._finalize_retire(dead, emit=False)
+        self.replaced_replicas += 1
+        tracer = getattr(successor.engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            tracer.emit(EV_SCALE, None, action="replace",
+                        replica=successor.index, replaced=dead.index,
+                        live=self.live_replicas)
+        return successor
+
+    def _finalize_retire(self, rep: ReplicaHandle, *, emit: bool = True
+                         ) -> None:
+        """DRAINING/DEAD -> RETIRED: close the journal (idempotent — a
+        fail-loud supervisor already closed it), keep the handle (stable
+        indices), stop its telemetry emission (`replica_samples` skips
+        retired handles)."""
+        if rep.retired:
+            return
+        tracer = getattr(rep.engine, "tracer", None)
+        try:
+            rep.supervisor.close()
+        except Exception:
+            pass
+        rep.draining = False
+        rep.retired = True
+        self.retired_replicas += 1
+        if emit and tracer is not None and tracer.enabled:
+            tracer.emit(EV_SCALE, None, action="retire", replica=rep.index,
+                        live=self.live_replicas)
+
+    @property
+    def live_replicas(self) -> int:
+        """Replicas not yet RETIRED (OK + DRAINING + DEAD)."""
+        return sum(1 for rep in self.replicas if not rep.retired)
 
     # ------------------------------------------------------------------ ids
     @property
     def n_replicas(self) -> int:
+        """Total handles ever created (retired included — stable indices)."""
         return len(self.replicas)
 
     def _cluster_rid_for(self, replica: int, engine_rid: int) -> int:
@@ -298,7 +460,7 @@ class ServingCluster:
         calm: list[ReplicaHandle] = []
         for rep in self.replicas:
             sup = rep.supervisor
-            if sup.unhealthy:
+            if rep.retired or rep.draining or sup.unhealthy:
                 continue
             if sup.brownout_level > 0 and request.priority < sup.brownout_level:
                 continue
@@ -415,28 +577,41 @@ class ServingCluster:
         return out
 
     def step(self) -> list[RequestOutput]:
-        """One cluster step: step every healthy replica with work, translate
-        ids, and — when a replica's restart budget just exhausted — migrate
-        its backlog before returning, so the caller never sees a
-        ``rejected:unhealthy`` for work another replica can finish."""
-        outputs: list[RequestOutput] = []
+        """One cluster step: step every healthy replica with work (DRAINING
+        included — drain-aware stepping is what lets in-flight work finish),
+        translate ids, and — when a replica's restart budget just exhausted —
+        migrate its backlog before returning, so the caller never sees a
+        ``rejected:unhealthy`` for work another replica can finish. A
+        DRAINING replica finalizes to RETIRED the moment it goes idle (or
+        dies mid-drain — its backlog just migrated, nothing left to wait
+        for). An attached `FleetAutoscaler` then runs one control
+        evaluation."""
+        outputs: list[RequestOutput] = self._pending_outputs
+        self._pending_outputs = []
         for rep in self.replicas:
-            sup = rep.supervisor
-            if sup.unhealthy or not sup.has_work:
+            if rep.retired:
                 continue
-            try:
-                produced = sup.step()
-            except EngineUnhealthyError:
-                produced = []
-            if sup.unhealthy and self.config.migrate:
-                produced = self._migrate(rep, produced)
-            outputs.extend(self._translate(rep.index, produced))
+            sup = rep.supervisor
+            if not sup.unhealthy and sup.has_work:
+                try:
+                    produced = sup.step()
+                except EngineUnhealthyError:
+                    produced = []
+                if sup.unhealthy and self.config.migrate:
+                    produced = self._migrate(rep, produced)
+                    rep.migrated = True
+                outputs.extend(self._translate(rep.index, produced))
+            if rep.draining and (sup.unhealthy or not sup.has_work):
+                self._finalize_retire(rep)
+        if self.autoscaler is not None:
+            outputs.extend(self.autoscaler.evaluate())
         return outputs
 
     @property
     def has_work(self) -> bool:
-        return any(rep.healthy and rep.supervisor.has_work
-                   for rep in self.replicas)
+        return bool(self._pending_outputs) or any(
+            rep.healthy and rep.supervisor.has_work
+            for rep in self.replicas)
 
     def drain(self, max_steps: int | None = None) -> list[RequestOutput]:
         """Graceful cluster shutdown: stop admissions everywhere, then step
@@ -545,7 +720,7 @@ class ServingCluster:
         cluster_rid = self._cluster_rid_for(dead.index, erid)
         # mirror resume(): a stream that already satisfied its budget or
         # emitted EOS completes here instead of being re-admitted
-        target = next((r for r in self.replicas if r.healthy), None)
+        target = next((r for r in self.replicas if r.accepting), None)
         done_reason = None
         eos = target.engine.eos_token_id if target is not None else None
         budget = sp.max_new_tokens
@@ -616,23 +791,35 @@ class ServingCluster:
         its index/role, and the healthy count the router admits against."""
         rows = []
         for rep in self.replicas:
+            if rep.retired:
+                continue
             hb = rep.supervisor.heartbeat()
             hb["replica"] = rep.index
             hb["role"] = rep.role
+            hb["state"] = rep.state
             rows.append(hb)
         return {
             "replicas": rows,
             "healthy": sum(1 for rep in self.replicas if rep.healthy),
-            "unhealthy": sum(1 for rep in self.replicas if not rep.healthy),
+            "unhealthy": sum(1 for rep in self.replicas
+                             if not rep.retired and not rep.healthy),
+            "draining": sum(1 for rep in self.replicas
+                            if not rep.retired and rep.draining),
+            "retired": self.retired_replicas,
             "migrations": self.migrations,
         }
 
     def router_stats(self) -> dict[str, Any]:
         """The ``cluster/*`` gauges (`ServingMetrics.snapshot` shape)."""
         return {
-            "cluster/replicas": self.n_replicas,
+            "cluster/replicas": self.live_replicas,
             "cluster/healthy_replicas": sum(
                 1 for rep in self.replicas if rep.healthy),
+            "cluster/draining_replicas": sum(
+                1 for rep in self.replicas
+                if not rep.retired and rep.draining),
+            "cluster/retired_replicas": self.retired_replicas,
+            "cluster/replaced_replicas": self.replaced_replicas,
             "cluster/migrations": self.migrations,
             "cluster/migrated_requests": self.migrated_requests,
             "cluster/routed_prefix": self._routed[POLICY_PREFIX],
@@ -666,7 +853,10 @@ class ServingCluster:
         on the calmest replica) and ``seconds_to_exhaustion`` the max."""
         totals: dict[str, Any] = {}
         for rep in self.replicas:
-            if not rep.healthy:
+            # DRAINING capacity is not admission capacity: a retiring
+            # replica takes no new placements, so its free slots must not
+            # relieve the fleet's predicted-TTFT admission gate
+            if not rep.accepting:
                 continue
             for k, v in rep.engine.capacity_headroom().items():
                 if isinstance(v, bool) or not isinstance(v, (int, float)):
@@ -679,13 +869,18 @@ class ServingCluster:
                     totals[k] = totals.get(k, 0) + v
         return totals
 
-    def replica_samples(self) -> list[dict[str, Any]]:
-        """Per-replica gauge dicts for the telemetry exporter's
-        ``replica<i>/`` namespace (`TelemetryExporter.sample`): each
-        replica's metrics snapshot, memory/headroom gauges, and its
-        cluster-view health (`cluster/healthy`, brownout level, role)."""
-        samples = []
+    def replica_samples(self) -> list[tuple[int, dict[str, Any]]]:
+        """Per-replica ``(stable index, gauge dict)`` pairs for the telemetry
+        exporter's ``replica<i>/`` namespace (`TelemetryExporter.sample`):
+        each replica's metrics snapshot, memory/headroom gauges, and its
+        cluster-view health (`cluster/healthy`, state, brownout level, role).
+        RETIRED replicas are skipped — they stop emitting rather than
+        renumbering, so every live series keeps its index across
+        retire/replace (the namespace-stability contract)."""
+        samples: list[tuple[int, dict[str, Any]]] = []
         for rep in self.replicas:
+            if rep.retired:
+                continue
             gauges: dict[str, Any] = dict(rep.metrics.snapshot())
             if rep.healthy:
                 for k, v in rep.engine.memory_stats().items():
@@ -698,8 +893,10 @@ class ServingCluster:
                     gauges.update(class_gauges())
             hb = rep.supervisor.heartbeat()
             gauges["cluster/healthy"] = int(rep.healthy)
+            gauges["cluster/draining"] = int(rep.draining)
+            gauges["cluster/state"] = rep.state
             gauges["cluster/brownout_level"] = hb["brownout_level"]
             gauges["cluster/restarts"] = hb["restarts"]
             gauges["cluster/role"] = rep.role
-            samples.append(gauges)
+            samples.append((rep.index, gauges))
         return samples

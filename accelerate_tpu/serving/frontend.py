@@ -436,7 +436,9 @@ class ServingFrontend:
         if self._is_cluster:
             best: dict[str, float] = {}
             for rep in self.target.replicas:
-                if not rep.healthy:
+                # a DRAINING replica takes no new placements, so its pace
+                # says nothing about the TTFT a fresh admission would see
+                if not getattr(rep, "accepting", rep.healthy):
                     continue
                 t = rep.engine.last_step_timings
                 if t and t.get("total_s", 0.0) >= best.get("total_s", 0.0):
@@ -449,10 +451,30 @@ class ServingFrontend:
         if self._is_cluster:
             total = 0
             for rep in self.target.replicas:
-                if rep.healthy:
+                if getattr(rep, "accepting", rep.healthy):
                     total += int(rep.engine.max_concurrency)
             return total or None
         return getattr(self._engine(), "max_concurrency", None)
+
+    def _scale_relief(self) -> float:
+        """Surge tolerance while a scale-up is in flight: when the target's
+        autoscaler wants MORE replicas than are currently accepting
+        (``target_replicas > actual``), capacity is already on the way, so
+        admission scales its TTFT estimate by ``actual / target`` and sheds
+        LESS — requests that would have been rejected ride out the spawn
+        instead of bouncing. 1.0 (no relief) for non-cluster targets,
+        clusters without an autoscaler, and steady-state fleets."""
+        if not self._is_cluster:
+            return 1.0
+        scaler = getattr(self.target, "autoscaler", None)
+        if scaler is None:
+            return 1.0
+        target = int(getattr(scaler, "target_replicas", 0))
+        actual = sum(1 for rep in self.target.replicas
+                     if getattr(rep, "accepting", rep.healthy))
+        if target > actual > 0:
+            return actual / target
+        return 1.0
 
     # -------------------------------------------------- journal resolution
     def _placement(self, rid: int) -> tuple[Path, int] | None:
@@ -504,6 +526,7 @@ class ServingFrontend:
         predicted = self.predict_ttft_now()
         if predicted is None:
             return None
+        predicted *= self._scale_relief()
         self.metrics.predicted_ttft_s.observe(predicted)
         if predicted <= float(slo.ttft_s) * self.admission_margin:
             return None

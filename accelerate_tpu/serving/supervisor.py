@@ -54,6 +54,7 @@ from .journal import MAGIC, RequestJournal
 from .metrics import ServingMetrics
 from .request import (
     FINISH_ERROR,
+    REJECT_DRAINING,
     REJECT_OVERLOAD,
     REJECT_UNHEALTHY,
     Request,
@@ -201,6 +202,7 @@ class EngineSupervisor:
         self._quarantines: deque[int] = deque(
             maxlen=max(1, int(self.config.storm_window_steps)))
         self._unhealthy = False
+        self._draining = False
         self._last_failure: tuple[str, BaseException | None] | None = None
         self._delivered: set[int] = set()
         self._pending: list[RequestOutput] = []
@@ -252,6 +254,27 @@ class EngineSupervisor:
         return self._unhealthy
 
     @property
+    def draining(self) -> bool:
+        """A sticky drain mark for drain-aware stepping: unlike the engine's
+        own ``begin_drain`` flag, this one survives the restart ladder — a
+        replica mid-retire that stalls and rebuilds must come back still
+        refusing admissions (`serving/autoscaler.py`'s lifecycle contract)."""
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admissions but keep stepping (the DRAINING half of the
+        cluster's retire lifecycle). Idempotent; persists across restarts
+        until `end_drain`."""
+        self._draining = True
+        if not self._unhealthy:
+            self._engine.begin_drain()
+
+    def end_drain(self) -> None:
+        self._draining = False
+        if not self._unhealthy:
+            self._engine.end_drain()
+
+    @property
     def restarts(self) -> int:
         return self._budget.used
 
@@ -273,6 +296,7 @@ class EngineSupervisor:
         tracer = getattr(self._engine, "tracer", None)
         return {
             "unhealthy": self._unhealthy,
+            "draining": self._draining,
             "last_step_s": self._last_step_s,
             "age_s": max(0.0, self._clock() - self._last_step_end),
             "dispatch_seq": int(getattr(tracer, "_seq", 0)),
@@ -298,6 +322,10 @@ class EngineSupervisor:
             self.metrics.supervisor_shed.inc()
             return SubmitResult(False, None, REJECT_UNHEALTHY,
                                 "restart budget exhausted — engine failed")
+        if self._draining:
+            self.metrics.requests_rejected.inc()
+            return SubmitResult(False, None, REJECT_DRAINING,
+                                "replica is draining toward retirement")
         if not isinstance(request, Request):
             request = Request(prompt=list(request),
                              params=params or SamplingParams())
@@ -431,6 +459,10 @@ class EngineSupervisor:
         except Exception:
             pass  # teardown of a broken engine is best-effort by definition
         self._engine = self._build_engine()
+        if self._draining:
+            # the rebuilt engine starts admitting by default; a draining
+            # replica must come back still closed to new work
+            self._engine.begin_drain()
         report = self._engine.resume()
         self.last_recovery = report
         self._last_failure = (kind, error)

@@ -306,12 +306,20 @@ class TelemetryExporter:
         # multi-replica source (`ServingCluster.replica_samples`): each
         # replica's gauges ride the same point under `replica<i>/...`, so
         # per-replica and cluster-total series never collide — in JSONL by
-        # key, in Prometheus by the {replica="i"} label the render adds
+        # key, in Prometheus by the {replica="i"} label the render adds.
+        # Samples arrive as (stable index, gauges) pairs — RETIRED replicas
+        # stop emitting and the survivors keep their indices, so a series
+        # never renumbers across a retire/replace (`docs/reliability.md`
+        # "Elastic fleet"); a bare dict list (legacy sources) falls back to
+        # positional indices
         replicas = getattr(engine, "replica_samples", None)
         if callable(replicas):
             for i, sub in enumerate(replicas()):
+                if (isinstance(sub, tuple) and len(sub) == 2
+                        and isinstance(sub[1], dict)):
+                    i, sub = sub
                 for k, v in sub.items():
-                    gauges[f"replica{i}/{k}"] = v
+                    gauges[f"replica{int(i)}/{k}"] = v
         point = sanitize_scalars(gauges)
         point["_step"] = (int(metrics.steps.value)
                           if metrics is not None else len(self._points))

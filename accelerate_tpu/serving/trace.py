@@ -87,13 +87,17 @@ EV_ANOMALY = "anomaly"        # detector fired/cleared [detector, phase, zscore]
 # per-request schema.
 EV_ROUTE = "route"            # router placed a submit [replica, policy, resumed]
 EV_MIGRATE = "migrate"        # journal-backed move [from_replica, to_replica, resumed]
+EV_SCALE = "scale"            # fleet size change [action, replica, target, actual]
+#                               (serving/autoscaler.py — action = "up" |
+#                               "retire" | "replace"; drain freezes ride
+#                               EV_ANOMALY detector="autoscale_thrash")
 
 TERMINAL_KINDS = frozenset({EV_FINISH, EV_REJECT})
 REQUEST_KINDS = frozenset(
     {EV_SUBMIT, EV_QUEUED, EV_ADMIT, EV_QUARANTINE, EV_FINISH, EV_REJECT}
 )
 SUPERVISOR_KINDS = frozenset({EV_STALL, EV_RESTART, EV_BROWNOUT, EV_ANOMALY})
-CLUSTER_KINDS = frozenset({EV_ROUTE, EV_MIGRATE})
+CLUSTER_KINDS = frozenset({EV_ROUTE, EV_MIGRATE, EV_SCALE})
 
 
 @dataclass(frozen=True)

@@ -101,6 +101,13 @@ def pytest_configure(config):
         "request hibernation / wake cost model — docs/serving.md \"KV "
         "tiering & hibernation\") — run standalone with `pytest -m tier`",
     )
+    config.addinivalue_line(
+        "markers",
+        "autoscaler: elastic fleet tests (serving/autoscaler.py scale-up / "
+        "drain-and-retire / dead-replica replacement / thrash hysteresis — "
+        "docs/reliability.md \"Elastic fleet\") — run standalone with "
+        "`pytest -m autoscaler`",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,582 @@
+"""Elastic fleet (`serving/autoscaler.py`, `docs/reliability.md` "Elastic
+fleet").
+
+The load-bearing contracts: the control loop scales only on SUSTAINED
+signals (consecutive breach/idle windows, dwell spacing, ThrashGuard
+freeze with a strictly-alternating EV_ANOMALY pair) — one slow step never
+spawns a replica and oscillation freezes scaling instead of flapping; the
+retire lifecycle is strict (DRAINING keeps stepping in-flight work, RETIRED
+means journal closed and zero requests lost, bit-exact vs solo generate);
+replica indices are stable and never reused, so telemetry namespaces,
+journal dirs, and trace names survive retires/replacements with index gaps;
+spawn failures (the ``cluster.replica_spawn`` fault point) retry under the
+seeded policy and exhaust into graceful degradation, never an exception.
+
+The control-loop units run against a host-only stub cluster with an
+injected clock — zero JAX, zero wall time. The lifecycle/parity tests drive
+real engines and ride the slow tier with the other cluster suites.
+"""
+
+import importlib.util
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+flax_nn = pytest.importorskip("flax.linen")
+
+pytestmark = [pytest.mark.serving, pytest.mark.autoscaler]
+
+_drives_engine = pytest.mark.slow
+
+from accelerate_tpu.models.generation import generate
+from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from accelerate_tpu.reliability import faults
+from accelerate_tpu.reliability.faults import FaultSpec
+from accelerate_tpu.serving import (
+    DETECTOR_THRASH,
+    FINISH_LENGTH,
+    AutoscalerConfig,
+    FleetAutoscaler,
+    Request,
+    SamplingParams,
+    ServingCluster,
+    SupervisorConfig,
+    TelemetryConfig,
+    TelemetryExporter,
+)
+from accelerate_tpu.serving.cluster import POLICY_ROUND_ROBIN, ClusterConfig
+from accelerate_tpu.serving.trace import EV_ANOMALY
+
+
+# ------------------------------------------------------------ stub fleet
+class _StubEngine:
+    def __init__(self, max_concurrency=2):
+        self.max_concurrency = max_concurrency
+        self.active_slots = 0
+        self.last_step_timings = {"total_s": 0.001}
+        self.scheduler = SimpleNamespace(queue_depth=0)
+        self.tracer = None
+
+
+class _StubReplica:
+    def __init__(self, index):
+        self.index = index
+        self.role = "mixed"
+        self.retired = False
+        self.draining = False
+        self.migrated = False
+        self.engine = _StubEngine()
+        self.supervisor = SimpleNamespace(unhealthy=False)
+
+    @property
+    def accepting(self):
+        return (not self.retired and not self.draining
+                and not self.supervisor.unhealthy)
+
+
+class _StubCluster:
+    """The exact surface `FleetAutoscaler` reads and drives — nothing else."""
+
+    def __init__(self, n=1):
+        self.replicas = [_StubReplica(i) for i in range(n)]
+        self.autoscaler = None
+        self.replaced_replicas = 0
+        self.queue_depth = 0
+        self.est_slot_free_s = None
+        self.spawn_script = []  # exception (or None) per add_replica call
+        self.adds = 0
+        self.retire_calls = []
+        self.force_calls = []
+        self.replace_calls = []
+        self.force_outputs = []
+
+    def _accepting(self):
+        return [r for r in self.replicas if r.accepting]
+
+    def capacity_headroom(self):
+        acc = self._accepting()
+        total = sum(r.engine.max_concurrency for r in acc)
+        active = sum(r.engine.active_slots for r in acc)
+        head = {"queue_depth": self.queue_depth,
+                "slots_free": total - active, "slots_total": total}
+        if self.est_slot_free_s is not None:
+            head["est_slot_free_s"] = self.est_slot_free_s
+        return head
+
+    def add_replica(self, role="mixed"):
+        if self.spawn_script:
+            exc = self.spawn_script.pop(0)
+            if exc is not None:
+                raise exc
+        self.adds += 1
+        rep = _StubReplica(len(self.replicas))
+        self.replicas.append(rep)
+        return rep
+
+    def retire_replica(self, index, *, force=False):
+        rep = self.replicas[index]
+        rep.draining = False
+        rep.retired = True
+        if force:
+            self.force_calls.append(index)
+            return list(self.force_outputs)
+        self.retire_calls.append(index)
+        return []
+
+    def replace_replica(self, index):
+        successor = self.add_replica()
+        self.replicas[index].retired = True
+        self.replace_calls.append(index)
+        self.replaced_replicas += 1
+        return successor
+
+
+class _StubTracer:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, rid, **fields):
+        self.events.append((kind, fields))
+
+
+def _scaler(cluster, clk, tracer=None, **cfg):
+    cfg.setdefault("target_ttft_s", 0.5)
+    cfg.setdefault("thrash_enter_events", 99)
+    return FleetAutoscaler(cluster, AutoscalerConfig(**cfg),
+                           clock=lambda: clk[0], sleep=lambda s: None,
+                           tracer=tracer)
+
+
+def _load(cluster, queue=4, w0=1.0):
+    """Saturate the stub: full slots + a queue → predicted TTFT breaches."""
+    cluster.queue_depth = queue
+    cluster.est_slot_free_s = w0
+    for r in cluster._accepting():
+        r.engine.active_slots = r.engine.max_concurrency
+
+
+def _idle(cluster):
+    cluster.queue_depth = 0
+    cluster.est_slot_free_s = None
+    for r in cluster._accepting():
+        r.engine.active_slots = 0
+
+
+# -------------------------------------------------------- control units
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(scale_up_windows=0)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(idle_slots_fraction=0.0)
+
+
+def test_scale_up_needs_consecutive_breach_windows():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_up_windows=3, max_replicas=3)
+    _load(cluster)
+    scaler.evaluate()
+    scaler.evaluate()
+    assert cluster.adds == 0  # two breaches are not three
+    scaler.evaluate()
+    assert cluster.adds == 1 and scaler.scale_ups == 1
+    assert scaler.target_replicas == 2
+    assert scaler.gauges()["autoscaler/actual_replicas"] == 2
+
+
+def test_one_slow_evaluation_never_spawns():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_up_windows=2, max_replicas=3)
+    for _ in range(3):  # breach / recover alternation: never 2 consecutive
+        _load(cluster)
+        scaler.evaluate()
+        _idle(cluster)
+        scaler.evaluate()
+    assert cluster.adds == 0
+    _load(cluster)
+    scaler.evaluate()
+    scaler.evaluate()
+    assert cluster.adds == 1
+
+
+def test_scale_down_retires_least_loaded_newest_first():
+    cluster = _StubCluster(3)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_down_idle_windows=2)
+    _idle(cluster)
+    cluster.replicas[0].engine.active_slots = 1  # r0 is the busy one
+    scaler.evaluate()
+    assert cluster.retire_calls == []
+    scaler.evaluate()
+    # r1 and r2 tie on load: the newest (highest index) goes first, the
+    # longest-lived replica — the warmest cache — survives
+    assert cluster.retire_calls == [2]
+    assert scaler.retires == 1 and scaler.target_replicas == 2
+
+
+def test_never_drains_below_min_replicas():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_down_idle_windows=1)
+    _idle(cluster)
+    for _ in range(5):
+        scaler.evaluate()
+    assert cluster.retire_calls == [] and scaler.retires == 0
+
+
+def test_dwell_spaces_scale_events():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_up_windows=1, dwell_s=10.0,
+                     max_replicas=4)
+    _load(cluster)
+    scaler.evaluate()
+    assert cluster.adds == 1
+    for t in range(1, 10):  # inside the dwell window: breach is ignored
+        clk[0] = float(t)
+        _load(cluster)
+        scaler.evaluate()
+    assert cluster.adds == 1
+    clk[0] = 10.5
+    _load(cluster)
+    scaler.evaluate()
+    assert cluster.adds == 2
+
+
+def test_eval_interval_gates_cadence():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, eval_interval_s=1.0)
+    scaler.evaluate()
+    clk[0] = 0.5
+    scaler.evaluate()  # too soon: a no-op
+    assert scaler.evaluations == 1
+    clk[0] = 1.1
+    scaler.evaluate()
+    assert scaler.evaluations == 2
+
+
+def test_thrash_guard_freezes_then_unfreezes_with_anomaly_pair():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    tracer = _StubTracer()
+    scaler = _scaler(cluster, clk, tracer=tracer, scale_up_windows=1,
+                     max_replicas=8, thrash_window_s=60.0,
+                     thrash_enter_events=2, thrash_exit_fraction=0.25,
+                     thrash_exit_s=5.0)
+    _load(cluster)
+    scaler.evaluate()
+    clk[0] = 0.1
+    _load(cluster)
+    scaler.evaluate()  # second event inside the window: frozen
+    assert scaler.frozen and cluster.adds == 2
+    assert scaler.gauges()["autoscaler/scale_frozen"] == 1
+    clk[0] = 0.2
+    _load(cluster)
+    scaler.evaluate()  # breach persists but scaling is frozen
+    assert cluster.adds == 2
+    _idle(cluster)
+    clk[0] = 61.0  # window empties; calm clock starts
+    scaler.evaluate()
+    assert scaler.frozen
+    clk[0] = 67.0  # calm for >= thrash_exit_s: unfreeze
+    scaler.evaluate()
+    assert not scaler.frozen
+    anomalies = [(f["phase"]) for k, f in tracer.events
+                 if k == EV_ANOMALY and f["detector"] == DETECTOR_THRASH]
+    assert anomalies == ["enter", "exit"]  # strictly alternating pair
+
+
+def test_spawn_retries_transient_failures_then_succeeds():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_up_windows=1, max_replicas=3)
+    cluster.spawn_script = [OSError("flaky"), OSError("flaky"), None]
+    _load(cluster)
+    scaler.evaluate()
+    assert cluster.adds == 1 and scaler.scale_ups == 1
+    assert scaler.spawn_retries == 2 and scaler.spawn_failures == 0
+
+
+def test_spawn_exhaustion_degrades_target_gracefully():
+    cluster = _StubCluster(1)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, scale_up_windows=1, max_replicas=3)
+    cluster.spawn_script = [OSError("down")] * 3  # every attempt fails
+    _load(cluster)
+    scaler.evaluate()
+    assert cluster.adds == 0 and scaler.scale_ups == 0
+    assert scaler.spawn_failures == 1
+    assert scaler.target_replicas == 1  # folded back to what the fleet has
+    _load(cluster)
+    scaler.evaluate()  # spawns recover: the breach re-raises the target
+    assert cluster.adds == 1 and scaler.target_replicas == 2
+
+
+def test_dead_replica_is_replaced():
+    cluster = _StubCluster(2)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk)
+    cluster.replicas[0].supervisor.unhealthy = True
+    _idle(cluster)
+    scaler.evaluate()
+    assert cluster.replace_calls == [0]
+    assert cluster.replaced_replicas == 1
+    assert scaler.gauges()["autoscaler/replaced"] == 1
+    assert scaler.scale_ups == 0  # a replacement is not a scale-up
+
+
+def test_dead_draining_replica_retires_instead_of_replacing():
+    cluster = _StubCluster(2)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk)
+    cluster.replicas[0].supervisor.unhealthy = True
+    cluster.replicas[0].draining = True  # the fleet was shrinking through it
+    _idle(cluster)
+    scaler.evaluate()
+    assert cluster.replace_calls == []
+
+
+def test_drain_grace_forces_migration_and_returns_outputs():
+    cluster = _StubCluster(2)
+    clk = [0.0]
+    scaler = _scaler(cluster, clk, drain_grace_evals=2,
+                     scale_down_idle_windows=99)
+    sentinel = object()
+    cluster.force_outputs = [sentinel]
+    cluster.replicas[1].draining = True
+    assert scaler.evaluate() == []
+    assert scaler.evaluate() == []
+    assert cluster.force_calls == []
+    outs = scaler.evaluate()  # grace exhausted: force-migrate NOW
+    assert cluster.force_calls == [1]
+    assert outs == [sentinel]  # migration deliverables surface via step()
+
+
+def test_gauges_match_declared_names():
+    cluster = _StubCluster(1)
+    scaler = _scaler(cluster, [0.0])
+    assert set(scaler.gauges()) == set(FleetAutoscaler.GAUGES)
+
+
+# ------------------------------------------------------------ tool units
+def test_serve_top_renders_fleet_line_and_lifecycle_rows():
+    spec = importlib.util.spec_from_file_location(
+        "serve_top",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "serve_top.py"))
+    st = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(st)
+    point = {
+        "_ts": 1700000000.0, "_step": 3,
+        "serving/mem/queue_depth": 0,
+        "autoscaler/target_replicas": 3,
+        "autoscaler/actual_replicas": 2,
+        "autoscaler/draining_replicas": 1,
+        "autoscaler/scale_ups": 2,
+        "autoscaler/retires": 1,
+        "autoscaler/replaced": 1,
+        "autoscaler/spawn_retries": 4,
+        "autoscaler/scale_frozen": 1,
+        # replica1 never emits (retired): the index GAP renders as RETIRED
+        "replica0/cluster/state": "draining",
+        "replica0/cluster/healthy": 1,
+        "replica0/cluster/role": "mixed",
+        "replica0/serving/mem/slots_total": 2,
+        "replica0/serving/mem/slots_active": 1,
+        "replica2/cluster/state": "ok",
+        "replica2/cluster/healthy": 1,
+        "replica2/cluster/role": "mixed",
+        "replica2/serving/mem/slots_total": 2,
+        "replica2/serving/mem/slots_active": 0,
+        "replica3/cluster/state": "retired",
+        "replica3/cluster/role": "mixed",
+    }
+    screen = st.render(point)
+    assert ("fleet  target 3 / actual 2 (1 draining), 2 scale-up(s), "
+            "1 retire(s), 1 replaced, spawn retries 4") in screen
+    assert "SCALE FROZEN" in screen
+    assert "r0 [mixed  ] DRAINING" in screen
+    assert "r1 [?      ] RETIRED" in screen  # index gap = retired replica
+    assert "r3 [mixed  ] RETIRED" in screen
+    # without autoscaler gauges the fleet line is absent, not zero-filled
+    bare = {k: v for k, v in point.items() if not k.startswith("autoscaler/")}
+    assert "fleet" not in st.render(bare)
+
+
+def test_trace_report_parses_stable_replica_indices_from_paths():
+    import tools.trace_report as trace_report
+
+    f = trace_report._trace_replica_index
+    assert f("/w/replica7/trace.json", 2) == 7
+    assert f("/w/replica12.trace.json", 0) == 12
+    assert f("/w/no_index_here.json", 3) == 3  # fallback: positional
+
+
+# --------------------------------------------------------- real engines
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    return module, params
+
+
+def _factory(module, params, concurrency=2):
+    def build(**kw):
+        return ServingEngine(module, params, max_concurrency=concurrency,
+                             prompt_buckets=(16, 32), max_queue=32, **kw)
+    return build
+
+
+from accelerate_tpu.serving import ServingEngine  # noqa: E402
+
+
+def _solo(module, params, prompt, n, seed=0):
+    ids = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+    out = generate(module, params, ids, max_new_tokens=n,
+                   rng=jax.random.key(seed))
+    return np.asarray(out)[0].tolist()
+
+
+def _requests(n, n_tokens=3, seed=11):
+    r = np.random.default_rng(seed)
+    return [Request(r.integers(0, 256, (4 + i,)).astype(np.int32).tolist(),
+                    SamplingParams(max_new_tokens=n_tokens))
+            for i in range(n)]
+
+
+def _drive(cluster, max_steps=500):
+    outs = {}
+    for _ in range(max_steps):
+        if not cluster.has_work:
+            break
+        for o in cluster.step():
+            outs[o.request_id] = o
+    return outs
+
+
+def _assert_parity(module, params, reqs, rids, outs):
+    for i, rid in enumerate(rids):
+        assert outs[rid].finish_reason == FINISH_LENGTH, outs[rid]
+        ref = _solo(module, params, reqs[i].prompt,
+                    reqs[i].params.max_new_tokens)
+        assert outs[rid].tokens == ref, f"token drift on rid {rid}"
+
+
+@pytest.mark.fault
+def test_spawn_fault_point_retries_and_leaves_no_debris(
+        model, tmp_path, fault_injection):
+    module, params = model
+    cluster = ServingCluster(_factory(module, params), tmp_path / "c",
+                             replicas=1)
+    scaler = FleetAutoscaler(cluster, AutoscalerConfig(max_replicas=4))
+    fault_injection(FaultSpec.io_error(faults.SCOPE_REPLICA_SPAWN,
+                                       at_calls=(0, 1)))
+    assert scaler._spawn_one()  # fails twice, lands on the third attempt
+    assert scaler.spawn_retries == 2 and scaler.spawn_failures == 0
+    assert cluster.replicas[1].index == 1
+    # failed attempts fired BEFORE any filesystem effect: no debris dirs
+    dirs = sorted(p.name for p in (tmp_path / "c").iterdir()
+                  if p.name.startswith("replica"))
+    assert dirs == ["replica0", "replica1"]
+    cluster.close()
+
+
+@_drives_engine
+def test_drain_retire_zero_lost_stable_indices_telemetry_skips_retired(
+        model, tmp_path):
+    module, params = model
+    workdir = tmp_path / "c"
+    cluster = ServingCluster(_factory(module, params), workdir, replicas=2,
+                             config=ClusterConfig(policy=POLICY_ROUND_ROBIN))
+    reqs = _requests(4)
+    rids = [cluster.submit(r).request_id for r in reqs]
+    outs = {o.request_id: o for o in cluster.step()}  # admit everywhere
+    cluster.retire_replica(0)
+    rep0 = cluster.replicas[0]
+    assert rep0.draining and not rep0.accepting and not rep0.retired
+    outs.update(_drive(cluster))  # DRAINING keeps stepping in-flight work
+    assert rep0.retired and not rep0.draining
+    _assert_parity(module, params, reqs, rids, outs)
+    # stable never-reused indices: the handle stays at its slot
+    assert [r.index for r in cluster.replicas] == [0, 1]
+    assert cluster.n_replicas == 2 and cluster.live_replicas == 1
+    # retired replicas stop emitting — no renumbering of survivors
+    exporter = TelemetryExporter(TelemetryConfig(interval_s=0.0))
+    point = exporter.sample(cluster)
+    exporter.close()
+    assert any(k.startswith("replica1/") for k in point)
+    assert not any(k.startswith("replica0/") for k in point)
+    cluster.close()
+    import tools.journal_fsck as journal_fsck
+
+    report, code = journal_fsck.fsck_all(str(workdir))
+    assert code == 0 and report["clean"] and report["journals"] == 2
+    assert report["replica_indices"] == [0, 1]
+
+
+@_drives_engine
+def test_forced_retire_migrates_backlog_bit_exact(model, tmp_path):
+    module, params = model
+    cluster = ServingCluster(_factory(module, params), tmp_path / "c",
+                             replicas=2,
+                             config=ClusterConfig(policy=POLICY_ROUND_ROBIN))
+    reqs = _requests(6)
+    rids = [cluster.submit(r).request_id for r in reqs]
+    outs = {o.request_id: o for o in cluster.step()}
+    forced = cluster.retire_replica(0, force=True)  # migrate the backlog NOW
+    outs.update({o.request_id: o for o in forced})
+    assert cluster.replicas[0].retired and cluster.replicas[0].migrated
+    outs.update(_drive(cluster))
+    _assert_parity(module, params, reqs, rids, outs)
+    cluster.close()
+
+
+@_drives_engine
+def test_autoscaler_replaces_dead_replica_with_successor(model, tmp_path):
+    module, params = model
+    cluster = ServingCluster(
+        _factory(module, params), tmp_path / "c", replicas=2,
+        config=ClusterConfig(policy=POLICY_ROUND_ROBIN),
+        supervisor_config=SupervisorConfig(max_restarts=0))
+    FleetAutoscaler(cluster, AutoscalerConfig(max_replicas=4,
+                                              thrash_enter_events=99))
+    reqs = _requests(4)
+    rids = [cluster.submit(r).request_id for r in reqs]
+    outs = {o.request_id: o for o in cluster.step()}
+
+    def _killed_step(*a, **kw):
+        raise RuntimeError("injected engine death")
+
+    cluster.replicas[0].engine.step = _killed_step
+    outs.update(_drive(cluster))  # death -> migrate -> autoscaler replaces
+    assert cluster.replicas[0].retired
+    assert cluster.replaced_replicas == 1
+    assert cluster.n_replicas == 3 and cluster.replicas[2].index == 2
+    _assert_parity(module, params, reqs, rids, outs)
+    cluster.close()
+
+
+@pytest.mark.slow
+def test_chaos_surge_drain_scales_retires_and_loses_nothing():
+    import tools.chaos_serve as chaos_serve
+
+    summary = chaos_serve.run_surge_drain(n_requests=12, warmup=3,
+                                          concurrency=2, max_replicas=2)
+    assert summary["value"] == 0  # zero lost requests
+    d = summary["detail"]
+    assert d["scale_ups"] >= 1 and d["retires"] >= 1
+    assert d["parity_drift"] == 0 and d["scale_frozen"] == 0
+    assert d["journals_clean"] == d["replicas_ever"]

@@ -123,9 +123,22 @@ Env knobs:
                         the CLUSTER must migrate its journaled backlog onto
                         the survivors with resume_tokens — zero lost, zero
                         drift, clean `journal_fsck --all` over the workdir
+                        "surge_drain" runs the ELASTIC-FLEET scenario
+                        (`serving/autoscaler.py`): a one-replica cluster
+                        with a `FleetAutoscaler` takes a 4x load step, the
+                        autoscaler scales up, a simulated SIGKILL lands on
+                        the original replica MID-DRAIN, and the load drop
+                        drains the fleet back to the floor — >= 1 scale-up,
+                        >= 1 retire, zero lost, zero drift, clean
+                        `journal_fsck --all`, scaling never thrash-frozen
   CHAOS_REPLICAS        replica_kill scenario: cluster size (default 2)
-  CHAOS_WORKDIR         replica_kill scenario: cluster workdir holding each
-                        replica's journal (default: a fresh temp dir)
+  CHAOS_MAX_REPLICAS    surge_drain scenario: autoscaler ceiling (default 3)
+  CHAOS_WARMUP          surge_drain scenario: baseline requests before the
+                        load step (default 4 — sizes the TTFT target off
+                        the measured idle prediction)
+  CHAOS_WORKDIR         replica_kill / surge_drain scenarios: cluster
+                        workdir holding each replica's journal (default: a
+                        fresh temp dir)
   CHAOS_RESTART_BUDGET  hang/storm scenarios: the supervisor's max_restarts
                         (default 3). 0 asserts the fail-fast contract
                         instead: first failure goes straight to unhealthy,
@@ -828,6 +841,226 @@ def run_replica_kill(
             "parity_drift": len(drift),
             "journals_clean": fsck_report["clean_journals"],
             "trace": trace_summary,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        },
+    }
+
+
+def run_surge_drain(
+    n_requests: int = 20,
+    warmup: int = 4,
+    concurrency: int = 2,
+    seed: int = 0,
+    pipeline_depth: int = 2,
+    max_replicas: int = 3,
+    verify_parity: bool = True,
+    workdir: str | None = None,
+) -> dict:
+    """Elastic-fleet scenario (``CHAOS_SCENARIO=surge_drain``,
+    `serving/autoscaler.py`, docs/reliability.md "Elastic fleet"): a
+    `ServingCluster` starts at ONE replica with a `FleetAutoscaler`
+    attached, a 4x load step drives the fleet-wide predicted TTFT past the
+    target so the AUTOSCALER (not this harness) scales up, and while the
+    surge is still in flight the original — most loaded — replica is put
+    into the DRAINING lifecycle and a simulated SIGKILL (a device error on
+    a zero-restart budget) lands on it MID-DRAIN: its journaled backlog
+    must migrate to the freshly spawned replicas bit-exactly. When the load
+    drops, idle windows accumulate and the autoscaler drain-and-retires the
+    fleet back to ``min_replicas``. Asserts: >= 1 scale-up, >= 1 autoscaled
+    retire, zero lost requests, zero token drift vs solo generate, every
+    journal clean under `tools/journal_fsck.py` ``--all`` (retired and
+    replaced replica dirs included), the fleet back at the floor, and
+    scaling NOT thrash-frozen."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.generation import generate
+    from accelerate_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from accelerate_tpu.serving import (
+        FINISH_EOS,
+        FINISH_LENGTH,
+        AutoscalerConfig,
+        FleetAutoscaler,
+        Request,
+        ServingCluster,
+        ServingEngine,
+        SupervisorConfig,
+        predict_ttft,
+    )
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_surge_")
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    module = GPT2LMHead(cfg)
+    params = module.init_params(jax.random.key(0))
+    trace = _trace(n_requests, 1e9, seed, int(module.config.vocab_size))
+    warmup = max(1, min(warmup, n_requests - 1))
+
+    def factory(**kw):
+        return ServingEngine(
+            module, params, max_concurrency=concurrency,
+            prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+            pipeline_depth=pipeline_depth, **kw,
+        )
+
+    cluster = ServingCluster(
+        factory, workdir, replicas=1,
+        supervisor_config=SupervisorConfig(max_restarts=0))
+    t0 = time.perf_counter()
+    submitted: list[int] = []
+    shed = 0
+    terminal: dict[int, str] = {}
+    outputs: dict[int, list[int]] = {}
+    req_by_id: dict[int, object] = {}
+
+    def pump(reqs):
+        nonlocal shed
+        for src in reqs:
+            result = cluster.submit(Request(src.prompt, src.params))
+            if result.accepted:
+                submitted.append(result.request_id)
+                req_by_id[result.request_id] = src
+            else:
+                shed += 1
+
+    def record(outs):
+        for out in outs:
+            terminal[out.request_id] = out.finish_reason
+            outputs[out.request_id] = out.tokens
+
+    # phase 1 — baseline at the fleet floor: compiles the decode step and
+    # establishes the idle TTFT prediction the surge threshold is sized
+    # against (a fixed threshold would race the host's actual step time)
+    pump(trace[:warmup])
+    while cluster.has_work:
+        record(cluster.step())
+    rep0 = cluster.replicas[0]
+    baseline = predict_ttft(
+        cluster.capacity_headroom(),
+        getattr(rep0.engine, "last_step_timings", None) or {},
+        max_concurrency=rep0.engine.max_concurrency) or 0.0
+    scaler = FleetAutoscaler(cluster, AutoscalerConfig(
+        min_replicas=1, max_replicas=max_replicas,
+        # idle predicts ~one step; the 4x queue predicts many slot
+        # turnarounds — 6x idle splits the two robustly on any host
+        target_ttft_s=max(6.0 * baseline, 0.02),
+        scale_up_windows=2,
+        idle_slots_fraction=0.5, scale_down_idle_windows=3,
+        dwell_s=0.0, drain_grace_evals=6,
+        # loose thrash window: this scenario's scripted churn must not
+        # freeze scaling (the freeze path has its own unit tests)
+        thrash_enter_events=64,
+    ))
+
+    # phase 2 — the 4x load step, then the kill: once the autoscaler has
+    # spawned, the ORIGINAL replica (holding the surge queue) starts the
+    # drain-and-retire lifecycle and immediately takes a fatal device error
+    # on its zero-restart budget — the in-process stand-in for a SIGKILL
+    # landing on a DRAINING replica mid-migration
+    pump(trace[warmup:])
+    killed = False
+    kill_state = None
+
+    def _killed_step():
+        raise RuntimeError("chaos: injected kill on draining replica")
+
+    while cluster.has_work:
+        if (not killed and scaler.scale_ups >= 1
+                and rep0.accepting and rep0.supervisor.has_work):
+            cluster.retire_replica(rep0.index)
+            kill_state = rep0.state
+            rep0.engine.step = _killed_step
+            killed = True
+        record(cluster.step())
+    assert killed, ("the surge never triggered a scale-up — no draining "
+                    "replica to kill")
+    assert kill_state == "draining", kill_state
+    assert rep0.retired, "the killed draining replica never finalized"
+    assert cluster.migrations >= 1, \
+        "the mid-drain kill never migrated the backlog"
+
+    # phase 3 — the load drop: idle evaluations accumulate and the
+    # autoscaler drains the spawned replicas back to the floor
+    for _ in range(200):
+        record(cluster.step())
+        accepting = sum(1 for r in cluster.replicas if r.accepting)
+        draining = sum(1 for r in cluster.replicas
+                       if not r.retired and r.draining)
+        if accepting == 1 and draining == 0 and not cluster.has_work:
+            break
+    accepting = sum(1 for r in cluster.replicas if r.accepting)
+    assert accepting == 1, \
+        f"fleet never converged to min_replicas: {accepting} accepting"
+    assert scaler.scale_ups >= 1, "no scale-up recorded"
+    assert scaler.retires >= 1, "the idle fleet never drain-and-retired"
+    assert not scaler.frozen, "scripted churn thrash-froze the autoscaler"
+    lost = sorted(set(submitted) - set(terminal))
+    assert not lost, f"lost requests across surge/drain: {lost}"
+
+    drift, checked = [], 0
+    if verify_parity:
+        for rid, reason in sorted(terminal.items()):
+            if reason not in (FINISH_EOS, FINISH_LENGTH):
+                continue
+            src = req_by_id[rid]
+            ids = jnp.asarray(np.asarray(src.prompt, np.int32)[None, :])
+            ref = generate(
+                module, params, ids,
+                max_new_tokens=src.params.max_new_tokens,
+                temperature=src.params.temperature, top_k=src.params.top_k,
+                rng=jax.random.key(src.params.seed),
+            )
+            checked += 1
+            if outputs[rid] != np.asarray(ref)[0].tolist():
+                drift.append(rid)
+        assert not drift, \
+            f"token drift across surge-drain migration: {drift}"
+
+    # every journal the elastic fleet left behind — retired, replaced, and
+    # live replica dirs alike — must audit clean as one sweep
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from journal_fsck import fsck_all  # noqa: E402
+    fsck_report, fsck_code = fsck_all(workdir)
+    assert fsck_code == 0, f"journal fsck --all failed: {fsck_report}"
+    assert fsck_report["journals"] == cluster.n_replicas, fsck_report
+
+    for rep in cluster.replicas:
+        if rep.accepting:
+            _assert_steady_state(rep.engine)
+
+    reasons: dict[str, int] = {}
+    for reason in terminal.values():
+        reasons[reason] = reasons.get(reason, 0) + 1
+    gauges = scaler.gauges()
+    cluster.close()
+    return {
+        "metric": "chaos_serve_surge_lost_requests",
+        "value": len(lost),
+        "unit": "requests",
+        "detail": {
+            "scenario": "surge_drain",
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "seed": seed,
+            "pipeline_depth": pipeline_depth,
+            "max_replicas": max_replicas,
+            "baseline_ttft_s": round(baseline, 6),
+            "scale_ups": scaler.scale_ups,
+            "retires": scaler.retires,
+            "retired_replicas": cluster.retired_replicas,
+            "replicas_ever": cluster.n_replicas,
+            "migrations": cluster.migrations,
+            "migrated_requests": cluster.migrated_requests,
+            "spawn_retries": scaler.spawn_retries,
+            "scale_frozen": gauges["autoscaler/scale_frozen"],
+            "shed_requests": shed,
+            "terminal_reasons": reasons,
+            "parity_checked": checked,
+            "parity_drift": len(drift),
+            "journals_clean": fsck_report["clean_journals"],
+            "replica_indices": fsck_report["replica_indices"],
             "wall_s": round(time.perf_counter() - t0, 3),
         },
     }
@@ -1542,6 +1775,19 @@ def main() -> None:
             pipeline_depth=_env_int("CHAOS_DEPTH", 2),
             verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
             trace_path=os.environ.get("CHAOS_TRACE") or None,
+            workdir=os.environ.get("CHAOS_WORKDIR") or None,
+        )
+        print(json.dumps(summary), flush=True)
+        return
+    if os.environ.get("CHAOS_SCENARIO", "").lower() == "surge_drain":
+        summary = run_surge_drain(
+            n_requests=_env_int("CHAOS_REQUESTS", 20),
+            warmup=_env_int("CHAOS_WARMUP", 4),
+            concurrency=_env_int("CHAOS_CONCURRENCY", 2),
+            seed=_env_int("CHAOS_SEED", 0),
+            pipeline_depth=_env_int("CHAOS_DEPTH", 2),
+            max_replicas=_env_int("CHAOS_MAX_REPLICAS", 3),
+            verify_parity=bool(_env_int("CHAOS_VERIFY_PARITY", 1)),
             workdir=os.environ.get("CHAOS_WORKDIR") or None,
         )
         print(json.dumps(summary), flush=True)

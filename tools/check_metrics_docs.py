@@ -39,7 +39,7 @@ _STAT_SUFFIXES = frozenset(
 # cache keys, scheduler priority classes): documented as a prefix, not
 # per-member
 _DYNAMIC_PREFIXES = ("serving/slo/", "serving/compile/", "serving/class/",
-                     "serving/host_tier/")
+                     "serving/host_tier/", "autoscaler/")
 _DEFAULT_DOC = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "docs", "observability.md")
@@ -49,10 +49,14 @@ def metric_families() -> list[str]:
     """Every family name a snapshot/telemetry export can produce, suffixes
     stripped and dynamic tails reduced to their documented prefix."""
     from accelerate_tpu.serving.anomaly import AnomalyMonitor
+    from accelerate_tpu.serving.autoscaler import FleetAutoscaler
     from accelerate_tpu.serving.metrics import ServingMetrics
 
     keys = set(ServingMetrics().snapshot())
     keys |= set(AnomalyMonitor().gauges())
+    # the fleet autoscaler's gauges ride the cluster metrics view's snapshot
+    # (serving/autoscaler.py — no live cluster needed, the names are static)
+    keys |= set(FleetAutoscaler.GAUGES)
     families = set()
     for key in keys:
         dyn = next((p for p in _DYNAMIC_PREFIXES if key.startswith(p)), None)

@@ -32,6 +32,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from pathlib import Path
 
@@ -87,22 +88,41 @@ def fsck_all(directory: str, *, compact: bool = False,
             rep = fsck(str(path), compact=compact,
                        keep_finished=keep_finished)
         except (JournalError, OSError) as exc:
-            reports.append({"path": str(path), "error": str(exc)})
+            rep = {"path": str(path), "error": str(exc)}
             code = 2
-            continue
+        replica = _replica_index(path)
+        if replica is not None:
+            rep["replica"] = replica
         reports.append(rep)
-        if not rep["clean"]:
+        if "error" not in rep and not rep["clean"]:
             code = max(code, 1)
+    # an elastic fleet's workdir legitimately holds retired/replaced replica
+    # dirs (closed journals, successor indices past the live count, index
+    # gaps where nothing was ever spawned under a reused number) — stable
+    # indices are the contract, not contiguity, so the sweep reports them
+    # and never flags a gap as an anomaly
+    indices = sorted({r["replica"] for r in reports if "replica" in r})
     return ({
         "path": str(directory),
         "journals": len(paths),
         "clean_journals": sum(1 for r in reports if r.get("clean")),
+        "replica_indices": indices,
         "submitted": sum(r.get("submitted", 0) for r in reports),
         "finished": sum(r.get("finished", 0) for r in reports),
         "in_flight": sum(len(r.get("in_flight", ())) for r in reports),
         "reports": reports,
         "clean": code == 0,
     }, code)
+
+
+def _replica_index(path: Path) -> int | None:
+    """The ``replica<i>`` index a cluster journal's directory encodes, or
+    None for a standalone journal."""
+    for part in reversed(path.parts):
+        m = re.fullmatch(r"replica(\d+)", part)
+        if m:
+            return int(m.group(1))
+    return None
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -12,7 +12,10 @@ row per scheduler priority class with queue depth / starvation / predictive
 shed counts, per-SLO-class attainment) — plus a sparkline of the decode rate
 over the trailing window. Cluster points render one row per replica with a
 stream-lag column (the delivery lag of streams tailing that replica's
-journal).
+journal) and a lifecycle column (ok / DRAINING / DEAD / RETIRED); when a
+`FleetAutoscaler` rides the cluster a ``fleet`` line shows target vs actual
+replica counts, drains in flight, and a ``SCALE FROZEN`` marker while the
+thrash guard holds scaling.
 
 One-shot by default (render the latest point and exit); ``--watch N``
 re-reads the file every N seconds until interrupted, like ``top``. All
@@ -293,9 +296,35 @@ def render(point: dict, history: list[dict] | None = None,
             f"{int(g('cluster/migrated_requests', 0))} request(s) moved, "
             f"routed prefix {int(g('cluster/routed_prefix', 0))} / "
             f"rr {int(g('cluster/routed_round_robin', 0))}")
-        for i in sorted(replicas):
+        # fleet line (serving/autoscaler.py — docs/reliability.md "Elastic
+        # fleet"): present only when a FleetAutoscaler rides the cluster.
+        # SCALE FROZEN marks the ThrashGuard holding further size changes.
+        target = g("autoscaler/target_replicas")
+        if target is not None:
+            frozen = (" — SCALE FROZEN"
+                      if g("autoscaler/scale_frozen", 0) else "")
+            lines.append(
+                f"fleet  target {int(target)} / actual "
+                f"{int(g('autoscaler/actual_replicas', 0))} "
+                f"({int(g('autoscaler/draining_replicas', 0))} draining), "
+                f"{int(g('autoscaler/scale_ups', 0))} scale-up(s), "
+                f"{int(g('autoscaler/retires', 0))} retire(s), "
+                f"{int(g('autoscaler/replaced', 0))} replaced, "
+                f"spawn retries {int(g('autoscaler/spawn_retries', 0))}"
+                f"{frozen}")
+        # retired replicas stop emitting rather than renumbering, so index
+        # gaps below the highest live index ARE the retired replicas — show
+        # them as RETIRED rows to keep the fleet's history readable
+        for i in range(max(replicas) + 1):
+            if i not in replicas:
+                lines.append(f"  r{i} [{'?':<7}] RETIRED")
+                continue
             r = replicas[i].get
-            if not r("cluster/healthy", 1):
+            state = str(r("cluster/state", "") or "")
+            if state == "retired":
+                lines.append(f"  r{i} [{r('cluster/role', '?'):<7}] RETIRED")
+                continue
+            if state == "dead" or not r("cluster/healthy", 1):
                 lines.append(f"  r{i} [{r('cluster/role', '?'):<7}] DEAD   "
                              f"restarts {int(r('cluster/restarts', 0))}")
                 continue
@@ -303,14 +332,19 @@ def render(point: dict, history: list[dict] | None = None,
             active = r("serving/mem/slots_active") or 0
             occ = f"{int(active)}/{int(total)} slots" if total else "slots ?"
             level = int(r("cluster/brownout_level", 0))
-            state = f"BROWNOUT L{level}" if level else "ok"
+            if state == "draining" or r("cluster/draining", 0):
+                col = "DRAINING"
+            elif level:
+                col = f"BROWNOUT L{level}"
+            else:
+                col = "ok"
             # stream-lag column: journal-append -> caller delivery for the
             # streams tailing THIS replica's journal (the frontend accounts
             # on the replica it reads, so replicas without streams show "-")
             lag = r("serving/stream_lag_s/p50")
             lag_txt = f"{1e3 * lag:.1f} ms" if lag is not None else "-"
             lines.append(
-                f"  r{i} [{r('cluster/role', '?'):<7}] {state:<12}"
+                f"  r{i} [{r('cluster/role', '?'):<7}] {col:<12}"
                 f"{r('serving/tokens_per_sec', 0.0):>8.1f} tok/s  {occ}, "
                 f"queue {int(r('serving/mem/queue_depth', 0) or 0)}, "
                 f"lag {lag_txt}, "

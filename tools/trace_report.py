@@ -43,6 +43,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -242,18 +243,33 @@ def report(path: str, *, top: int = 5, slots: bool = True) -> dict:
     }
 
 
+def _trace_replica_index(path: str, fallback: int) -> int:
+    """The ``replica<i>`` index a cluster trace path encodes (filename or any
+    parent dir), else ``fallback``. An elastic fleet leaves non-contiguous
+    indices behind (retired replicas keep theirs, successors take fresh
+    ones), so the positional index is only the last resort."""
+    for part in reversed(os.path.normpath(path).split(os.sep)):
+        m = re.search(r"replica(\d+)", part)
+        if m:
+            return int(m.group(1))
+    return fallback
+
+
 def multi_report(paths: list[str], *, top: int = 5, slots: bool = True) -> dict:
     """Per-file `report` over a cluster's per-replica traces, with every
     request id prefixed ``r<i>:`` (engine-level ids collide across replicas;
-    the prefix is the cluster-level name), plus a combined roll-up and a
+    the prefix is the cluster-level name — ``i`` is the stable replica index
+    parsed from the path when present, so retired/replaced replicas with
+    index gaps keep their names), plus a combined roll-up and a
     cross-replica slowest list. Raises like `report` on the FIRST unreadable
     path — partial cluster reports would hide a missing replica."""
     reports: list[dict] = []
     for i, path in enumerate(paths):
         rep = report(path, top=top, slots=slots)
-        rep["replica"] = i
+        idx = _trace_replica_index(str(path), i)
+        rep["replica"] = idx
         for row in rep["slowest"]:
-            row["rid"] = f"r{i}:{row['rid']}"
+            row["rid"] = f"r{idx}:{row['rid']}"
         reports.append(rep)
     slowest = sorted(
         (row for rep in reports for row in rep["slowest"]),
