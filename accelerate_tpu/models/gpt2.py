@@ -142,14 +142,17 @@ class SelfAttention(nn.Module):
             from ..ops.flash_attention import paged_decode_attention
             from .kv_cache import paged_decode_write
 
-            k_pool, v_pool, idx, is_init = paged_decode_write(
+            k_pool, v_pool, idx, is_init, scale_pools = paged_decode_write(
                 self, k, v, cfg.kv_num_blocks, cfg.kv_block_tokens,
-                block_tables, write_mask=cache_write_mask,
+                block_tables, kv_cache_dtype=cfg.kv_cache_dtype,
+                write_mask=cache_write_mask,
                 sharding=cfg.kv_cache_sharding,
             )
             if is_init:
+                k_sp, v_sp = scale_pools if scale_pools is not None else (None, None)
                 out = paged_decode_attention(
-                    q[:, 0], k_pool, v_pool, block_tables, idx + 1
+                    q[:, 0], k_pool, v_pool, block_tables, idx + 1,
+                    k_scale_pool=k_sp, v_scale_pool=v_sp,
                 )[:, None]  # [b, 1, n_head, head_dim]
             else:
                 # abstract shape-init trace: no pool yet, plain causal
@@ -162,7 +165,8 @@ class SelfAttention(nn.Module):
 
             k_all, v_all, idx, is_init = paged_decode_update(
                 self, k, v, cfg.kv_num_blocks, cfg.kv_block_tokens,
-                block_tables, write_mask=cache_write_mask,
+                block_tables, kv_cache_dtype=cfg.kv_cache_dtype,
+                write_mask=cache_write_mask,
                 write_len=cache_write_len, sharding=cfg.kv_cache_sharding,
             )
             if is_init:

@@ -21,6 +21,22 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _q(x):
+    """Blockwise int8 quantization: one fp32 absmax scale per trailing-axis
+    group (per (…, kv-head) row). Returns ``(int8 values, fp32 scales)``;
+    all-zero rows get scale 1.0 so the dequantized zero stays exact."""
+    absmax = jnp.abs(x.astype(jnp.float32)).max(axis=-1)
+    scale = jnp.where(absmax > 0, absmax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dq(q, scale, dtype):
+    """Inverse of `_q`: int8 values × fp32 scales, cast to compute dtype."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def decode_cache_update(
     mod: Any,  # the flax module (self) owning the "cache" collection
     k: jax.Array,  # [b, s, kv_heads, head_dim] new keys
@@ -96,16 +112,6 @@ def decode_cache_update(
 
     if not is_init:
         return k, v, cache_idx.value, False
-
-    def _q(x):
-        absmax = jnp.abs(x.astype(jnp.float32)).max(axis=-1)
-        scale = jnp.where(absmax > 0, absmax, 1.0) / 127.0
-        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                     -127, 127).astype(jnp.int8)
-        return q, scale
-
-    def _dq(q, scale, dtype):
-        return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
     idx = cache_idx.value
     next_idx = idx + s
@@ -192,19 +198,23 @@ def decode_cache_update(
 
 
 def _paged_frontier_write(
-    pool_k: jax.Array,  # [num_blocks, block_tokens, kv_heads, head_dim]
-    pool_v: jax.Array,
-    k: jax.Array,  # [b, s, kv_heads, head_dim] new keys
-    v: jax.Array,
+    pools: tuple[jax.Array, ...],  # per-leaf [num_blocks, block_tokens, ...] pools
+    news: tuple[jax.Array, ...],  # congruent [b, s, ...] new rows to land
     idx: jax.Array,  # [b] int32 write cursors
     mask: jax.Array,  # [b] bool: False rows freeze (dropped write)
     write_len: jax.Array | None,  # [b] int32 per-row segment cap, or None (s==1)
     num_blocks: int,
     block_tokens: int,
     block_tables: jax.Array,  # [b, blocks_per_slot] int32 pool block ids
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[tuple[jax.Array, ...], jax.Array]:
     """The append-at-frontier pool write shared by `paged_decode_update` and
-    `paged_decode_write`: returns ``(new_pool_k, new_pool_v, next_idx)``.
+    `paged_decode_write`: returns ``(new_pools, next_idx)``.
+
+    ``pools``/``news`` are congruent tuples of pool leaves and their new
+    rows — (K, V) at full precision, (K, V, K-scale, V-scale) when the pool
+    stores int8 (the fp32 scale planes are ``[num_blocks, block_tokens,
+    kv_heads]`` and land through the same block ids/offsets, so a KV byte and
+    its scale can never diverge).
 
     ``write_len=None`` is the classic one-token step (``s == 1``). With
     ``write_len`` ([b] int32) the segment path lands row ``i``'s first
@@ -213,14 +223,14 @@ def _paged_frontier_write(
     the rest redirect to block id ``num_blocks`` and are dropped, so a verify
     segment can never write into blocks the row's reservation does not own.
     """
-    b, s = k.shape[:2]
+    b, s = news[0].shape[:2]
     if write_len is None:
         bids = block_tables[jnp.arange(b), idx // block_tokens]  # [b]
         bids = jnp.where(mask, bids, num_blocks)  # frozen rows: dropped write
         offs = idx % block_tokens
-        new_k = pool_k.at[bids, offs].set(k[:, 0], mode="drop")
-        new_v = pool_v.at[bids, offs].set(v[:, 0], mode="drop")
-        return new_k, new_v, idx + mask.astype(idx.dtype)
+        out = tuple(pool.at[bids, offs].set(new[:, 0], mode="drop")
+                    for pool, new in zip(pools, news))
+        return out, idx + mask.astype(idx.dtype)
     wl = jnp.clip(write_len.astype(idx.dtype), 0, s) * mask.astype(idx.dtype)
     cols = idx[:, None] + jnp.arange(s, dtype=idx.dtype)[None, :]  # [b, s]
     valid = jnp.arange(s)[None, :] < wl[:, None]
@@ -229,9 +239,87 @@ def _paged_frontier_write(
                         jnp.clip(cols // block_tokens, 0, bps - 1)]
     bids = jnp.where(valid, bids, num_blocks)  # clamped/frozen: dropped write
     offs = cols % block_tokens
-    new_k = pool_k.at[bids, offs].set(k, mode="drop")
-    new_v = pool_v.at[bids, offs].set(v, mode="drop")
-    return new_k, new_v, idx + wl
+    out = tuple(pool.at[bids, offs].set(new, mode="drop")
+                for pool, new in zip(pools, news))
+    return out, idx + wl
+
+
+def _paged_pool_step(
+    mod: Any,
+    k: jax.Array,
+    v: jax.Array,
+    num_blocks: int,
+    block_tokens: int,
+    block_tables: jax.Array | None,
+    kv_cache_dtype: Any,
+    write_mask: jax.Array | None,
+    write_len: jax.Array | None,
+    sharding: Any,
+) -> tuple[tuple[jax.Array, ...], jax.Array, bool]:
+    """Shared body of `paged_decode_update` / `paged_decode_write`: create the
+    pool variables (int8 payload + fp32 scale planes when quantized), run the
+    append-at-frontier write, pin shardings, commit. Returns
+    ``(pool_leaves, write_index, is_init)`` where ``pool_leaves`` is
+    ``(k_pool, v_pool)`` at full precision or
+    ``(k_pool, v_pool, k_scale_pool, v_scale_pool)`` under int8."""
+    if kv_cache_dtype is not None and np.dtype(kv_cache_dtype) != np.dtype("int8"):
+        raise ValueError(
+            f"kv_cache_dtype supports None (compute dtype) or int8, got {kv_cache_dtype}"
+        )
+    quant = kv_cache_dtype is not None
+    b, s, kv_heads, head_dim = k.shape
+    store_dtype = jnp.int8 if quant else k.dtype
+    is_init = mod.has_variable("cache", "cached_key")
+    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), store_dtype)
+    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
+                            (num_blocks, block_tokens, kv_heads, head_dim), store_dtype)
+    if quant:
+        k_scale = mod.variable("cache", "key_scale", jnp.zeros,
+                               (num_blocks, block_tokens, kv_heads), jnp.float32)
+        v_scale = mod.variable("cache", "value_scale", jnp.zeros,
+                               (num_blocks, block_tokens, kv_heads), jnp.float32)
+    cache_idx = mod.variable("cache", "cache_index",
+                             lambda: jnp.zeros((b,), jnp.int32))
+    if not is_init:
+        return (), cache_idx.value, False
+    if s != 1 and write_len is None:
+        raise ValueError(
+            f"paged decode writes one token per step, got a length-{s} segment "
+            "(prefill runs through the contiguous admission cache, then "
+            "scatter_rows_to_blocks; multi-token verify segments must pass "
+            "write_len)"
+        )
+    if block_tables is None:
+        raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
+    idx = cache_idx.value  # [b]
+    mask = (jnp.ones((b,), bool) if write_mask is None
+            else write_mask.astype(bool))
+    if quant:
+        kq, ks = _q(k)
+        vq, vs = _q(v)
+        pools = (cached_k.value, cached_v.value, k_scale.value, v_scale.value)
+        news = (kq, vq, ks, vs)
+    else:
+        pools = (cached_k.value, cached_v.value)
+        news = (k, v)
+    new_pools, next_idx = _paged_frontier_write(
+        pools, news, idx, mask, write_len,
+        num_blocks, block_tokens, block_tables,
+    )
+    if sharding is not None:
+        kv_specs = (sharding.kv, sharding.kv) + (
+            (sharding.scale, sharding.scale) if quant else ())
+        new_pools = tuple(
+            jax.lax.with_sharding_constraint(leaf, spec)
+            for leaf, spec in zip(new_pools, kv_specs)
+        )
+        next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
+    cached_k.value, cached_v.value = new_pools[0], new_pools[1]
+    if quant:
+        k_scale.value, v_scale.value = new_pools[2], new_pools[3]
+    cache_idx.value = next_idx
+    return new_pools, idx, True
 
 
 def paged_decode_update(
@@ -241,9 +329,10 @@ def paged_decode_update(
     num_blocks: int,  # pool size; block id == num_blocks is the dropped write
     block_tokens: int,
     block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
+    kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized pool
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
     write_len: jax.Array | None = None,  # [b] int32: per-row segment length cap
-    sharding: Any = None,  # KVCacheSharding with pool kv / index / gathered
+    sharding: Any = None,  # KVCacheSharding with pool kv / scale / index / gathered
 ) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
     """Paged variant of `decode_cache_update`: the cache collection holds ONE
     shared ``[num_blocks, block_tokens, ...]`` block pool (per layer) plus the
@@ -263,51 +352,39 @@ def paged_decode_update(
     them are masked out of attention at the frontier, so stale pool contents
     cannot perturb a stream (the parity bar of `docs/serving.md`).
 
-    int8 KV storage is not supported paged (quantization scales would need
-    their own block planes); the serving engine rejects the combination at
-    construction.
+    ``kv_cache_dtype=int8`` stores the pool quantized: the int8 payload rides
+    the usual ``[num_blocks, block_tokens, kv_heads, head_dim]`` leaves and
+    the fp32 absmax scales ride sibling ``key_scale``/``value_scale`` pool
+    leaves of shape ``[num_blocks, block_tokens, kv_heads]`` — per-block
+    planes addressed through the SAME block table, mirroring the slot path's
+    per-(batch, position, kv-head) scheme. The gathered attended view is
+    dequantized here (scales gathered alongside the payload), so attention
+    sees compute-dtype K/V either way.
     """
     b, s, kv_heads, head_dim = k.shape
-    is_init = mod.has_variable("cache", "cached_key")
-    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
-                            (num_blocks, block_tokens, kv_heads, head_dim), k.dtype)
-    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
-                            (num_blocks, block_tokens, kv_heads, head_dim), v.dtype)
-    cache_idx = mod.variable("cache", "cache_index",
-                             lambda: jnp.zeros((b,), jnp.int32))
-    if not is_init:
-        return k, v, cache_idx.value, False
-    if s != 1 and write_len is None:
-        raise ValueError(
-            f"paged decode writes one token per step, got a length-{s} segment "
-            "(prefill runs through the contiguous admission cache, then "
-            "scatter_rows_to_blocks; multi-token verify segments must pass "
-            "write_len)"
-        )
-    if block_tables is None:
-        raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
-    idx = cache_idx.value  # [b]
-    mask = (jnp.ones((b,), bool) if write_mask is None
-            else write_mask.astype(bool))
-    new_k, new_v, next_idx = _paged_frontier_write(
-        cached_k.value, cached_v.value, k, v, idx, mask, write_len,
-        num_blocks, block_tokens, block_tables,
+    new_pools, idx, is_init = _paged_pool_step(
+        mod, k, v, num_blocks, block_tokens, block_tables, kv_cache_dtype,
+        write_mask, write_len, sharding,
     )
-    if sharding is not None:
-        new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
-        new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
-        next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
-    cached_k.value, cached_v.value = new_k, new_v
-    cache_idx.value = next_idx
+    if not is_init:
+        return k, v, idx, False
     # the attended view: each row's table blocks concatenated in token order —
     # position p of row i sits at gathered index p (block p // block_tokens,
     # offset p % block_tokens), the same layout the slot-pool cache has, so
     # the caller's frontier mask is identical in both modes
     blocks_per_slot = block_tables.shape[1]
-    k_all = new_k[block_tables].reshape(b, blocks_per_slot * block_tokens,
-                                        kv_heads, head_dim)
-    v_all = new_v[block_tables].reshape(b, blocks_per_slot * block_tokens,
-                                        kv_heads, head_dim)
+    span = blocks_per_slot * block_tokens
+
+    def _view(pool):
+        return pool[block_tables].reshape((b, span) + pool.shape[2:])
+
+    if kv_cache_dtype is not None:
+        new_k, new_v, new_ks, new_vs = new_pools
+        k_all = _dq(_view(new_k), _view(new_ks), k.dtype)
+        v_all = _dq(_view(new_v), _view(new_vs), v.dtype)
+    else:
+        new_k, new_v = new_pools
+        k_all, v_all = _view(new_k), _view(new_v)
     if sharding is not None and getattr(sharding, "gathered", None) is not None:
         k_all = jax.lax.with_sharding_constraint(k_all, sharding.gathered)
         v_all = jax.lax.with_sharding_constraint(v_all, sharding.gathered)
@@ -321,52 +398,37 @@ def paged_decode_write(
     num_blocks: int,  # pool size; block id == num_blocks is the dropped write
     block_tokens: int,
     block_tables: jax.Array | None,  # [b, blocks_per_slot] int32 pool block ids
+    kv_cache_dtype: Any = None,  # None = store at k.dtype; int8 = quantized pool
     write_mask: jax.Array | None = None,  # [b] bool: False rows freeze
     write_len: jax.Array | None = None,  # [b] int32: per-row segment length cap
-    sharding: Any = None,  # KVCacheSharding with pool kv / index
-) -> tuple[jax.Array, jax.Array, jax.Array, bool]:
+    sharding: Any = None,  # KVCacheSharding with pool kv / scale / index
+) -> tuple[jax.Array, jax.Array, jax.Array, bool, tuple[jax.Array, jax.Array] | None]:
     """Write-only variant of `paged_decode_update` for the fused attention
     path: identical append-at-frontier write and cursor semantics, but returns
-    the UPDATED POOL leaves — ``(k_pool, v_pool, write_index, is_init)`` with
-    the pool still ``[num_blocks, block_tokens, ...]`` — instead of gathering
-    the contiguous ``[b, span, ...]`` attended view. The Pallas kernel
-    (`ops.flash_attention.paged_decode_attention`) then reads the blocks in
-    place through the block table, so no per-layer per-step gather copy is
-    ever materialized. Frozen rows (``write_mask`` False) still redirect their
-    write to the dropped block id and keep their cursor."""
-    b, s, kv_heads, head_dim = k.shape
-    is_init = mod.has_variable("cache", "cached_key")
-    cached_k = mod.variable("cache", "cached_key", jnp.zeros,
-                            (num_blocks, block_tokens, kv_heads, head_dim), k.dtype)
-    cached_v = mod.variable("cache", "cached_value", jnp.zeros,
-                            (num_blocks, block_tokens, kv_heads, head_dim), v.dtype)
-    cache_idx = mod.variable("cache", "cache_index",
-                             lambda: jnp.zeros((b,), jnp.int32))
-    if not is_init:
-        return k, v, cache_idx.value, False
-    if s != 1 and write_len is None:
-        raise ValueError(
-            f"paged decode writes one token per step, got a length-{s} segment "
-            "(prefill runs through the contiguous admission cache, then "
-            "scatter_rows_to_blocks; multi-token verify segments must pass "
-            "write_len)"
-        )
-    if block_tables is None:
-        raise ValueError("paged decode needs block_tables ([b, blocks_per_slot])")
-    idx = cache_idx.value  # [b]
-    mask = (jnp.ones((b,), bool) if write_mask is None
-            else write_mask.astype(bool))
-    new_k, new_v, next_idx = _paged_frontier_write(
-        cached_k.value, cached_v.value, k, v, idx, mask, write_len,
-        num_blocks, block_tokens, block_tables,
+    the UPDATED POOL leaves — ``(k_pool, v_pool, write_index, is_init,
+    scale_pools)`` with the pool still ``[num_blocks, block_tokens, ...]`` —
+    instead of gathering the contiguous ``[b, span, ...]`` attended view. The
+    Pallas kernel (`ops.flash_attention.paged_decode_attention`) then reads
+    the blocks in place through the block table, so no per-layer per-step
+    gather copy is ever materialized. Frozen rows (``write_mask`` False) still
+    redirect their write to the dropped block id and keep their cursor.
+
+    ``scale_pools`` is ``None`` at full precision; under
+    ``kv_cache_dtype=int8`` it is ``(k_scale_pool, v_scale_pool)`` — the fp32
+    absmax planes (``[num_blocks, block_tokens, kv_heads]``) the kernel needs
+    to dequantize each block in VMEM scratch, so the pool is never
+    materialized at fp32."""
+    new_pools, idx, is_init = _paged_pool_step(
+        mod, k, v, num_blocks, block_tokens, block_tables, kv_cache_dtype,
+        write_mask, write_len, sharding,
     )
-    if sharding is not None:
-        new_k = jax.lax.with_sharding_constraint(new_k, sharding.kv)
-        new_v = jax.lax.with_sharding_constraint(new_v, sharding.kv)
-        next_idx = jax.lax.with_sharding_constraint(next_idx, sharding.index)
-    cached_k.value, cached_v.value = new_k, new_v
-    cache_idx.value = next_idx
-    return new_k, new_v, idx, True
+    if not is_init:
+        return k, v, idx, False, None
+    if kv_cache_dtype is not None:
+        new_k, new_v, new_ks, new_vs = new_pools
+        return new_k, new_v, idx, True, (new_ks, new_vs)
+    new_k, new_v = new_pools
+    return new_k, new_v, idx, True, None
 
 
 def _is_index_leaf(path) -> bool:

@@ -732,8 +732,8 @@ def flash_attention(
 
 # ------------------------------------------------- paged decode (serving)
 def _paged_decode_kernel(
-    tables, lengths, q_ref, k_ref, v_ref, o_ref, k_scr, v_scr,
-    *, block_tokens, span, scale, groups, exact,
+    tables, lengths, q_ref, k_ref, v_ref, *rest,
+    block_tokens, span, scale, groups, exact,
 ):
     """One grid cell = (slot row, table block j). The block axis is LAST —
     sequential on a TensorCore — so the K/V blocks the table names accumulate
@@ -750,7 +750,19 @@ def _paged_decode_kernel(
     formulation differs by ~1 ulp — so keeping heads batched makes the fused
     path bit-identical to `dot_product_attention` over the gathered view,
     which is the parity bar the serving tests hold (docs/serving.md). On TPU
-    the flush unrolls per head into MXU-friendly 2-D dots instead."""
+    the flush unrolls per head into MXU-friendly 2-D dots instead.
+
+    An int8 pool rides two extra refs — the fp32 absmax scale planes
+    (``[1, block_tokens, kv_heads]`` per block) — and each block dequantizes
+    AT STAGING into the fp32 VMEM scratch (value × scale, round-tripped
+    through the compute dtype exactly like the gather oracle's `_dq`), so the
+    quantized pool is never materialized at full precision in HBM and the
+    flush math below is byte-for-byte the same in both modes."""
+    if len(rest) == 5:
+        ks_ref, vs_ref, o_ref, k_scr, v_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, k_scr, v_scr = rest
     b_ = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -759,8 +771,15 @@ def _paged_decode_kernel(
 
     @pl.when(j * block_tokens < length)
     def _():
-        k_scr[window] = k_ref[0].astype(jnp.float32)  # [bt, kv_heads, d]
-        v_scr[window] = v_ref[0].astype(jnp.float32)
+        if ks_ref is None:
+            k_scr[window] = k_ref[0].astype(jnp.float32)  # [bt, kv_heads, d]
+            v_scr[window] = v_ref[0].astype(jnp.float32)
+        else:
+            cdt = q_ref.dtype
+            k_scr[window] = (k_ref[0].astype(jnp.float32)
+                             * ks_ref[0][..., None]).astype(cdt).astype(jnp.float32)
+            v_scr[window] = (v_ref[0].astype(jnp.float32)
+                             * vs_ref[0][..., None]).astype(cdt).astype(jnp.float32)
 
     @pl.when(j * block_tokens >= length)
     def _():
@@ -828,6 +847,8 @@ def paged_decode_attention(
     block_tables: jax.Array,  # [b, blocks_per_slot] int32 pool block ids
     lengths: jax.Array,  # [b] int32 valid kv positions (frontier cursor + 1)
     *,
+    k_scale_pool: jax.Array | None = None,  # [num_blocks, block_tokens, kv_heads]
+    v_scale_pool: jax.Array | None = None,  # fp32 absmax planes (int8 pool)
     scale: float | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
@@ -851,13 +872,27 @@ def paged_decode_attention(
     interpreter (`docs/serving.md` "Fused paged decode"); spans beyond a few
     thousand tokens should stay on the gather path until an online-softmax
     variant exists. Returns ``[b, n_heads, head_dim]`` in ``q.dtype``. On
-    CPU (tests/CI) runs under the Pallas interpreter."""
+    CPU (tests/CI) runs under the Pallas interpreter.
+
+    An int8 pool (`kv_cache_dtype=int8` paged serving) passes its fp32 absmax
+    planes as ``k_scale_pool``/``v_scale_pool`` (``[num_blocks, block_tokens,
+    kv_heads]``, addressed through the same block table); each block is
+    dequantized in VMEM scratch at staging time, so the quantized pool is
+    never materialized at full precision."""
     b, hq, d = q.shape
     num_blocks, block_tokens, kvh, dk = k_pool.shape
     if dk != d:
         raise ValueError(f"q head_dim {d} != pool head_dim {dk}")
     if hq % kvh:
         raise ValueError(f"q heads ({hq}) must be a multiple of kv heads ({kvh})")
+    if (k_scale_pool is None) != (v_scale_pool is None):
+        raise ValueError("k_scale_pool and v_scale_pool must be passed together")
+    quant = k_scale_pool is not None
+    if quant and k_scale_pool.shape != (num_blocks, block_tokens, kvh):
+        raise ValueError(
+            f"scale pool shape {k_scale_pool.shape} != "
+            f"{(num_blocks, block_tokens, kvh)} (per-block absmax planes)"
+        )
     groups = hq // kvh
     bps = block_tables.shape[1]
     span = bps * block_tokens
@@ -871,20 +906,37 @@ def paged_decode_attention(
     tables = jnp.minimum(block_tables.astype(jnp.int32), num_blocks - 1)
     lengths = lengths.astype(jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+        pl.BlockSpec(
+            (1, block_tokens, kvh, d),
+            lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, block_tokens, kvh, d),
+            lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
+        ),
+    ]
+    inputs = [tables, lengths, q, k_pool, v_pool]
+    if quant:
+        # the scale planes page in through the same block-table index map as
+        # their payload blocks, one [block_tokens, kv_heads] plane per cell
+        in_specs += [
+            pl.BlockSpec(
+                (1, block_tokens, kvh),
+                lambda b_, j, t, l: (t[b_, j], 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_tokens, kvh),
+                lambda b_, j, t, l: (t[b_, j], 0, 0),
+            ),
+        ]
+        inputs += [k_scale_pool.astype(jnp.float32),
+                   v_scale_pool.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, bps),
-        in_specs=[
-            pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
-            pl.BlockSpec(
-                (1, block_tokens, kvh, d),
-                lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_tokens, kvh, d),
-                lambda b_, j, t, l: (t[b_, j], 0, 0, 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((span, kvh, d), jnp.float32),
@@ -900,4 +952,4 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
         interpret=interpret,
-    )(tables, lengths, q, k_pool, v_pool)
+    )(*inputs)

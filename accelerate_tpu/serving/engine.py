@@ -86,6 +86,13 @@ from ..parallel.sharding import (
     shard_params,
 )
 from ..reliability.faults import ALL_SLOTS, active_injector
+from ..utils.quantization import (
+    QuantizationConfig,
+    QuantizedModule,
+    QuantizedTensor,
+    quantize_params,
+    quantized_nbytes,
+)
 from .anomaly import NULL_ANOMALY
 from .journal import MAGIC as JOURNAL_MAGIC
 from .journal import JournalScan, RequestJournal, request_record
@@ -224,6 +231,71 @@ class PagedKVConfig:
     num_blocks: int | None = None
 
 
+@dataclasses.dataclass(frozen=True)
+class WeightQuantConfig:
+    """Knobs for the engine's ``weight_quant=`` argument (`docs/serving.md`
+    "Quantized serving").
+
+    ``mode`` picks the packed format: ``"int8"`` is per-channel absmax
+    (`utils/quantization.QuantizationConfig(load_in_8bit=True)`), ``"nf4"``
+    is blockwise 4-bit NormalFloat over ``block_size``-element groups (the
+    `ops/nf4_matmul.py` codebook). Leaves smaller than ``min_weight_size``
+    elements (embeddings' peers: LayerNorm scales, biases) stay dense — the
+    same eligibility rule `quantize_params` applies everywhere else.
+
+    The engine quantizes the param tree ONCE at load and the jitted
+    step/admit/spec programs consume the packed leaves directly:
+    `QuantizedModule.apply` dequantizes inside the trace, so XLA fuses
+    unpack+scale into the consuming matmuls and HBM holds only payload +
+    scales. fp streams are untouched — ``weight_quant=None`` (the default)
+    changes no module, no params, and no trace."""
+
+    mode: str = "int8"
+    block_size: int = 64
+    min_weight_size: int = 4096
+
+    def __post_init__(self):
+        if self.mode not in ("int8", "nf4"):
+            raise ValueError(
+                f"weight_quant mode must be 'int8' or 'nf4', got {self.mode!r}")
+
+    def quantization_config(self, compute_dtype: Any) -> QuantizationConfig:
+        """The `utils/quantization.QuantizationConfig` this mode maps onto.
+        ``compute_dtype`` should be the module's param dtype so dequantized
+        leaves re-enter the model at the precision the fp path used."""
+        if self.mode == "int8":
+            return QuantizationConfig(
+                load_in_8bit=True,
+                compute_dtype=compute_dtype,
+                min_weight_size=self.min_weight_size,
+            )
+        return QuantizationConfig(
+            load_in_4bit=True,
+            quant_type="nf4",
+            block_size=self.block_size,
+            compute_dtype=compute_dtype,
+            min_weight_size=self.min_weight_size,
+        )
+
+
+# Per-(module, mode) cache of `QuantizedModule` wrappers. The wrapper IS the
+# `_SHARED_JITS` key for a quantized engine (entries key on id(module)), so
+# caching it per mode does double duty: engines over the same base module and
+# quant mode share every trace exactly like fp engines do, while different
+# modes — and the fp path, which keeps the bare module — can never
+# cross-contaminate a trace cache. Entries pin the wrapper (which pins the
+# base module), so neither id() can be reused by a new object.
+_QUANT_MODULES: dict[tuple[int, str], QuantizedModule] = {}
+
+
+def _quantized_module(module: Any, mode: str) -> QuantizedModule:
+    key = (id(module), mode)
+    wrapper = _QUANT_MODULES.get(key)
+    if wrapper is None or wrapper.module is not module:
+        wrapper = _QUANT_MODULES[key] = QuantizedModule(module)
+    return wrapper
+
+
 # Process-level cache of the unsharded engines' jitted programs. An unsharded
 # engine's step/admit closures depend only on the module (every per-engine
 # quantity — slot count, buckets, sampling state — enters as a traced argument
@@ -339,6 +411,7 @@ class ServingEngine:
         anomaly: Any = None,
         scheduler: Any = None,
         kv_tier: KVTierConfig | bool | None = None,
+        weight_quant: WeightQuantConfig | str | None = None,
     ):
         cfg = getattr(module, "config", None)
         if cfg is None or not hasattr(cfg, "kv_cache_per_slot"):
@@ -369,11 +442,11 @@ class ServingEngine:
                     f"paged_kv block_tokens must be a power of two dividing "
                     f"n_positions={n_pos}, got {bt}"
                 )
-            if getattr(cfg, "kv_cache_dtype", None) is not None:
-                raise ValueError(
-                    "paged_kv does not support quantized (kv_cache_dtype) KV "
-                    "storage yet — the block pool stores the model dtype"
-                )
+            # kv_cache_dtype=int8 composes with paging: the block pool stores
+            # the int8 payload and carries the fp32 absmax scales as sibling
+            # [num_blocks, block_tokens, kv_heads] pool leaves addressed
+            # through the same block table (models/kv_cache.py
+            # `paged_decode_write`) — no rejection, no special casing here.
             self._block_tokens = bt
             self._blocks_per_slot = n_pos // bt
             # default pool: byte-for-byte the slot pool's KV footprint, so a
@@ -499,16 +572,51 @@ class ServingEngine:
             self._admit_module = type(module)(dataclasses.replace(
                 module.config, **admit_updates
             ))
-        self.params = params
+        # quantized weights (docs/serving.md "Quantized serving"): quantize
+        # the param tree ONCE here and hand every jitted program the packed
+        # leaves directly — the `QuantizedModule` wrapper dequantizes inside
+        # the trace. Off (None): module, params, and every trace below stay
+        # byte-for-byte the fp engine's.
+        if isinstance(weight_quant, str):
+            weight_quant = WeightQuantConfig(mode=weight_quant)
+        self.weight_quant = weight_quant
+        self._dense_param_bytes = int(tree_nbytes(params))
+        dense_shardings = None
         if self.mesh is not None:
             # Megatron-style TP placement via the training-path rules (callers
             # serving a non-GPT-2 model pass their own ``param_rules``);
-            # unmatched / scalar / 1-D leaves come out replicated
+            # unmatched / scalar / 1-D leaves come out replicated. Derived
+            # over the DENSE tree — packed leaves re-derive below.
             rules = param_rules if param_rules is not None else gpt2_sharding_rules()
-            self._param_shardings = infer_param_shardings(
+            dense_shardings = infer_param_shardings(
                 params, self.mesh, rules=rules
             )
-            self.params = shard_params(params, self._param_shardings)
+        if weight_quant is not None:
+            qcfg = weight_quant.quantization_config(
+                getattr(module.config, "param_dtype", None) or jnp.float32)
+            params = quantize_params(params, qcfg)
+            raw_admit = self._admit_module
+            self.module = module = _quantized_module(module, weight_quant.mode)
+            self._admit_module = (
+                module if raw_admit is module.module
+                else _quantized_module(raw_admit, weight_quant.mode))
+        self.params = params
+        if self.mesh is not None:
+            if weight_quant is None:
+                self._param_shardings = dense_shardings
+                self.params = shard_params(params, self._param_shardings)
+            else:
+                # packed shapes can't take the dense TP rules: a
+                # QuantizedTensor subtree replicates (its 1-D payload/scale
+                # children follow — the `quantize_model` precedent), while
+                # leaves that stayed dense keep their rule-matched placement
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                is_qt = lambda x: isinstance(x, QuantizedTensor)  # noqa: E731
+                self._param_shardings = jax.tree.map(
+                    lambda q, s: rep if is_qt(q) else s,
+                    params, dense_shardings, is_leaf=is_qt,
+                )
+                self.params = jax.device_put(params, self._param_shardings)
         self.max_len = int(module.config.n_positions)
         self.pipeline_depth = int(pipeline_depth)
         if self.pipeline_depth < 1:
@@ -1673,7 +1781,9 @@ class ServingEngine:
         from the host slot mirrors, and the per-device numbers use
         `device.memory_stats()` when the backend provides it (TPU/GPU; a CPU
         host simply omits them). Keys are unprefixed — the telemetry exporter
-        namespaces them under ``serving/mem/``."""
+        namespaces them under ``serving/mem/``, except the ``quant/`` group
+        (present only when a quantized mode is active — `quant_stats`), which
+        it lifts to the top-level ``serving/quant/`` namespace."""
         stats: dict[str, Any] = {
             "slot_pool_bytes": tree_nbytes(self._cache),
             "slots_total": self.max_concurrency,
@@ -1684,6 +1794,8 @@ class ServingEngine:
         }
         for dtype, n in tree_bytes_by_dtype(self._cache).items():
             stats[f"slot_pool_bytes/{dtype}"] = n
+        for k, v in self.quant_stats().items():
+            stats[f"quant/{k}"] = v
         if self.paged:
             # paged mode: ``slot_pool_bytes`` above IS the block pool (the
             # engine's cache tree holds it), so the block_pool/ gauges report
@@ -1726,6 +1838,32 @@ class ServingEngine:
             for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
                 if key in dm:
                     stats[f"device{i}/{key}"] = int(dm[key])
+        return stats
+
+    def quant_stats(self) -> dict[str, Any]:
+        """Quantized-serving gauges (`docs/observability.md` "serving/quant"),
+        ``{}`` whenever no quantized mode is active — a full-precision
+        engine's telemetry points carry no quant keys at all.
+
+        Weight side (``weight_quant=``): exact packed+scale bytes
+        (`quantized_nbytes` — what the jitted programs actually hold
+        resident) against the dense-equivalent bytes captured at load, so
+        headroom math and `tools/serve_top.py` see the freed HBM. KV side
+        (``kv_cache_dtype=int8``): storage bits plus the exact split of the
+        live cache tree into int8 payload and fp32 absmax-scale bytes."""
+        stats: dict[str, Any] = {}
+        if self.weight_quant is not None:
+            packed = int(quantized_nbytes(self.params))
+            stats["weight_bits"] = 8 if self.weight_quant.mode == "int8" else 4
+            stats["weight_packed_bytes"] = packed
+            stats["weight_dense_bytes"] = self._dense_param_bytes
+            stats["weight_saved_bytes"] = self._dense_param_bytes - packed
+        kv_dtype = getattr(self.module.config, "kv_cache_dtype", None)
+        if kv_dtype is not None:
+            by_dtype = tree_bytes_by_dtype(self._cache)
+            stats["kv_bits"] = jnp.dtype(kv_dtype).itemsize * 8
+            stats["kv_payload_bytes"] = int(by_dtype.get("int8", 0))
+            stats["kv_scale_bytes"] = int(by_dtype.get("float32", 0))
         return stats
 
     def capacity_headroom(self) -> dict[str, Any]:

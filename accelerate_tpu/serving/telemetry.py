@@ -65,6 +65,21 @@ __all__ = [
 # this prefix + underscores (serving/mem/pool -> accelerate_tpu_serving_mem_pool)
 PROM_NAMESPACE = "accelerate_tpu"
 
+# the quantized-serving gauge family (`ServingEngine.quant_stats`, lifted out
+# of the memory_stats namespace by `sample` below). Emitted ONLY when a
+# quantized mode is active, so `tools/check_metrics_docs.py` can't discover
+# them from a fresh fp surface — this static tuple is what it lints against
+# `docs/observability.md` instead. Keep it in sync with quant_stats.
+QUANT_GAUGES = (
+    "serving/quant/weight_bits",
+    "serving/quant/weight_packed_bytes",
+    "serving/quant/weight_dense_bytes",
+    "serving/quant/weight_saved_bytes",
+    "serving/quant/kv_bits",
+    "serving/quant/kv_payload_bytes",
+    "serving/quant/kv_scale_bytes",
+)
+
 
 # ------------------------------------------------------- non-finite guard
 def finite_or_none(value: Any) -> Any:
@@ -285,7 +300,13 @@ class TelemetryExporter:
         mem = getattr(engine, "memory_stats", None)
         if mem is not None:
             for k, v in mem().items():
-                gauges[f"serving/mem/{k}"] = v
+                # quantized-serving gauges (engine.quant_stats, present only
+                # when a quantized mode is active) are a first-class family,
+                # not a memory detail: lift them to serving/quant/...
+                if k.startswith("quant/"):
+                    gauges[f"serving/{k}"] = v
+                else:
+                    gauges[f"serving/mem/{k}"] = v
         head = getattr(engine, "capacity_headroom", None)
         if head is not None:
             for k, v in head().items():

@@ -117,6 +117,23 @@ detail carries the tier-off ceiling, page-in p99 wall seconds
 probe raises the thrash-guard threshold out of reach: spill churn IS the
 mechanism under measurement, freezing it would measure the guard instead.
 
+``BENCH_SERVE_WORKLOAD=quant`` measures quantized serving
+(`docs/serving.md` "Quantized serving") in TWO rows.
+"serving_quant_kv_bytes_per_token": exact nbytes of the paged block pool
+(every cache-tree leaf keyed by block index — the KV tier's own sizing
+rule) amortized over its token capacity, probed per mode at identical
+block geometry; value = the int8 store's bytes/token (int8 payload + fp32
+absmax scale planes), vs_baseline = int8 / bf16 (asserted <= 0.55 in the
+bench: the scales amortize over block_tokens), detail carries the
+fp32/bf16/int8 payload-vs-scale split. `tools/bench_gate.py` treats any
+``kv_bytes_per_token`` name as lower-is-better. "serving_quant_peak_streams":
+the fp32 pool's byte budget re-spent on int8 blocks — the SAME all-at-once
+ragged trace through a tier-off fp32-KV engine and an int8-KV engine whose
+pool holds the byte-equal number of int8 blocks (compute dtype fp32 on both
+sides, so KV storage is the only variable), tracking peak concurrent
+in-flight streams per step; value = the int8 peak, vs_baseline = int8 /
+fp32 peak (asserted >= 1.8: quantization is admission capacity).
+
 ``BENCH_SERVE_WORKLOAD=surge`` measures the elastic fleet
 (`serving/autoscaler.py`, `docs/reliability.md` "Elastic fleet"): a
 three-phase trace — baseline load, a ``BENCH_SERVE_SURGE_MULT``× (default
@@ -172,8 +189,13 @@ Env knobs (defaults saturate an 8-slot engine on the host CPU in ~a minute):
   BENCH_SERVE_ADMIT        admit_batch for both engine runs (default 4)
   BENCH_SERVE_WORKLOAD     "ragged" (default) | "prefix" (shared system
                            prompt) | "cluster" (multi-replica router rows) |
-                           "tiered" (host-RAM KV tier) | "surge" (elastic
-                           fleet under a load step)
+                           "tiered" (host-RAM KV tier) | "quant" (int8 KV
+                           capacity rows) | "surge" (elastic fleet under a
+                           load step)
+  BENCH_SERVE_QUANT_BLOCKS quant mode: fp32 pool blocks setting the shared
+                           HBM byte budget (default 12)
+  BENCH_SERVE_QUANT_SLOTS  quant mode: slot count for both engines, high so
+                           the pool, not the slots, binds (default 32)
   BENCH_SERVE_MAX_REPLICAS surge mode: autoscaler ceiling (default 3)
   BENCH_SERVE_SURGE_MULT   surge mode: arrival-rate multiplier for the
                            middle third of the trace (default 4.0)
@@ -1336,6 +1358,130 @@ def main_tiered() -> None:
     }), flush=True)
 
 
+def _pool_bytes_by_dtype(engine, num_blocks: int) -> dict[str, int]:
+    """Exact nbytes of the paged block pool, split by storage dtype: every
+    cache-tree leaf keyed by block index (leading dim == ``num_blocks``), the
+    same rule the KV tier uses to size host copies
+    (`serving/kv_tier.py` ``block_bytes``). Under ``kv_cache_dtype=int8``
+    this is the int8 payload plus the fp32 absmax scale planes; at full
+    precision it is a single compute-dtype entry."""
+    out: dict[str, int] = {}
+    for leaf in jax.tree.leaves(engine._cache):
+        shape = getattr(leaf, "shape", ())
+        if shape and shape[0] == num_blocks:
+            key = str(leaf.dtype)
+            out[key] = out.get(key, 0) + int(leaf.nbytes)
+    return out
+
+
+def main_quant() -> None:
+    from accelerate_tpu.serving import PagedKVConfig
+
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 32)
+    seed = _env_int("BENCH_SERVE_SEED", 0)
+    depth = _env_int("BENCH_SERVE_DEPTH", 2)
+    admit = _env_int("BENCH_SERVE_ADMIT", 4)
+    block_tokens = 16
+    num_blocks = _env_int("BENCH_SERVE_QUANT_BLOCKS", 12)
+    slots = _env_int("BENCH_SERVE_QUANT_SLOTS", 32)
+
+    def build(dtype, kv_dtype, blocks, max_conc):
+        cfg = GPT2Config(vocab_size=2048, n_positions=128, n_embd=512,
+                         n_layer=6, n_head=8, dtype=dtype, param_dtype=dtype,
+                         kv_cache_dtype=kv_dtype)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+        return ServingEngine(
+            module, params, max_concurrency=max_conc,
+            prompt_buckets=BUCKETS, max_queue=n_requests + 1,
+            pipeline_depth=depth, admit_batch=admit,
+            paged_kv=PagedKVConfig(block_tokens=block_tokens,
+                                   num_blocks=blocks))
+
+    # --- row 1: exact KV bytes per token of pool capacity, per mode -------
+    # construction-only probes (the pool is allocated eagerly; nbytes are
+    # allocation-time constants) at identical block geometry
+    cap_tokens = num_blocks * block_tokens
+    per_mode: dict[str, dict] = {}
+    pool_totals: dict[str, int] = {}
+    for mode, dtype, kv_dtype in (("fp32", jnp.float32, None),
+                                  ("bf16", jnp.bfloat16, None),
+                                  ("int8", jnp.bfloat16, jnp.int8)):
+        by_dtype = _pool_bytes_by_dtype(build(dtype, kv_dtype, num_blocks, 8),
+                                        num_blocks)
+        total = sum(by_dtype.values())
+        pool_totals[mode] = total
+        per_mode[mode] = {
+            "kv_bytes_per_token": round(total / cap_tokens, 2),
+            "payload_bytes_per_token":
+                round(by_dtype.get("int8", total) / cap_tokens, 2),
+            "scale_bytes_per_token":
+                round(by_dtype.get("float32", 0) / cap_tokens, 2)
+                if kv_dtype is not None else 0.0,
+        }
+    int8_bpt = per_mode["int8"]["kv_bytes_per_token"]
+    bf16_bpt = per_mode["bf16"]["kv_bytes_per_token"]
+    ratio = int8_bpt / bf16_bpt
+    # the headline capacity claim: int8 payload + fp32 scales must cost at
+    # most 0.55x the bf16 store (scales amortize over block_tokens)
+    assert ratio <= 0.55, (int8_bpt, bf16_bpt, ratio)
+    print(json.dumps({
+        "metric": "serving_quant_kv_bytes_per_token",
+        "value": int8_bpt,
+        "unit": "bytes/token",
+        "vs_baseline": round(ratio, 4),
+        "detail": {
+            "platform": _host_platform(),
+            "block_tokens": block_tokens,
+            "num_blocks": num_blocks,
+            "int8_over_bf16": round(ratio, 4),
+            "modes": per_mode,
+        },
+    }), flush=True)
+
+    # --- row 2: peak concurrent streams at EQUAL HBM budget ---------------
+    # the fp32 pool's byte budget, re-spent on int8 blocks: quantization is
+    # admission capacity, not just smaller numbers. Compute dtype stays fp32
+    # on both sides so KV storage is the only variable.
+    # per-block bytes from the row-1 probes (pool bytes are independent of
+    # the compute dtype: int8 payload + fp32 scale planes either way)
+    fp32_block_bytes = pool_totals["fp32"] // num_blocks
+    int8_block_bytes = pool_totals["int8"] // num_blocks
+    budget = num_blocks * fp32_block_bytes
+    int8_blocks = budget // int8_block_bytes
+    trace = _trace(n_requests, 1e9, seed, 2048)
+
+    fp_engine = build(jnp.float32, None, num_blocks, slots)
+    _tiered_probe(fp_engine, trace[: min(6, len(trace))])  # warm the jits
+    fp = _tiered_probe(fp_engine, trace)
+    q_engine = build(jnp.float32, jnp.int8, int8_blocks, slots)
+    _tiered_probe(q_engine, trace[: min(6, len(trace))])
+    q = _tiered_probe(q_engine, trace)
+    vs = q["peak_streams"] / max(fp["peak_streams"], 1)
+    assert vs >= 1.8, (q["peak_streams"], fp["peak_streams"], vs)
+    print(json.dumps({
+        "metric": "serving_quant_peak_streams",
+        "value": q["peak_streams"],
+        "unit": "concurrent_streams",
+        "vs_baseline": round(vs, 3),
+        "detail": {
+            "platform": _host_platform(),
+            "requests": n_requests,
+            "max_concurrency": slots,
+            "block_tokens": block_tokens,
+            "hbm_budget_bytes": int(budget),
+            "fp32_blocks": num_blocks,
+            "int8_blocks": int(int8_blocks),
+            "fp32_block_bytes": int(fp32_block_bytes),
+            "int8_block_bytes": int(int8_block_bytes),
+            "pipeline_depth": depth,
+            "admit_batch": admit,
+            "fp32": fp,
+            "int8": q,
+        },
+    }), flush=True)
+
+
 def _surge_requests(n: int, seed: int, vocab: int) -> list[Request]:
     """The ragged mix with its decode length floored at 8 tokens: the raw
     mix averages ~4 decode tokens per request, so prefill dominates service
@@ -1562,6 +1708,9 @@ def main() -> None:
         return
     if workload == "tiered":
         main_tiered()
+        return
+    if workload == "quant":
+        main_quant()
         return
     if workload == "surge":
         main_surge()

@@ -108,6 +108,13 @@ def pytest_configure(config):
         "docs/reliability.md \"Elastic fleet\") — run standalone with "
         "`pytest -m autoscaler`",
     )
+    config.addinivalue_line(
+        "markers",
+        "quant: quantized serving tests (int8 paged KV pools with sibling "
+        "scale planes, engine ``weight_quant=`` int8/nf4 packed weights, "
+        "per-mode parity oracles — docs/serving.md \"Quantized serving\") — "
+        "run standalone with `pytest -m quant`",
+    )
 
 
 @pytest.fixture

@@ -105,8 +105,11 @@ def test_engine_validates_paged_config(model):
     cfg8 = GPT2Config.tiny(dtype=jnp.float32, kv_cache_dtype=jnp.int8)
     m8 = GPT2LMHead(cfg8)
     p8 = m8.init_params(jax.random.key(0))
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        ServingEngine(m8, p8, paged_kv=True, **kw)
+    # kv_cache_dtype=int8 now COMPOSES with paging (the pool stores int8
+    # payload + sibling fp32 scale planes, tests/test_quant_serving.py) —
+    # construction must succeed and the pool must really be quantized
+    eng8 = ServingEngine(m8, p8, paged_kv=True, **kw)
+    assert eng8.quant_stats()["kv_bits"] == 8
     with pytest.raises(ValueError, match="block_tokens"):
         # paged pool and trie must agree on the block quantum
         ServingEngine(module, params, paged_kv=PagedKVConfig(block_tokens=32),

@@ -99,7 +99,12 @@ def test_pool_bytes_match_nbytes_across_dtypes(kind):
 
 
 # -------------------------------------------------- occupancy gauge parity
-@pytest.mark.parametrize("tier", [False, True], ids=["plain", "tier"])
+@pytest.mark.parametrize("tier", [
+    "plain", "tier",
+    # the quantized cells re-drive the whole spill matrix over int8 blocks —
+    # multi-second each, slow-gated like the other heavy matrices
+    pytest.param("tier-quant", marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("depth", [1, 2, 4])
 @pytest.mark.parametrize("admit", [1, 4])
 def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
@@ -109,11 +114,20 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
     The ``tier`` cells run the paged pool with the host KV tier attached
     and additionally hold the cross-tier byte invariant (``host_tier/bytes
     == blocks × block_bytes``, and the trie's spilled sub-ledger agrees
-    with the tier's) through spill-driven churn."""
-    module, params = model
+    with the tier's) through spill-driven churn. The ``tier-quant`` cells
+    rerun that with an int8 pool: every invariant must hold unchanged at
+    the HALVED block bytes (int8 payload + fp32 scale planes spill and
+    page together, so the cross-tier ledger never sees an fp32 block)."""
+    quant = tier == "tier-quant"
+    if quant:
+        cfg = GPT2Config.tiny(dtype=jnp.float32, kv_cache_dtype=jnp.int8)
+        module = GPT2LMHead(cfg)
+        params = module.init_params(jax.random.key(0))
+    else:
+        module, params = model
     kw = dict(max_concurrency=3, prompt_buckets=(8, 32), max_queue=8,
               pipeline_depth=depth, admit_batch=admit)
-    if tier:
+    if tier != "plain":
         # 16 blocks is one full row — the minimum pool, so pressure is real
         kw.update(prefix_cache=PrefixCacheConfig(block_tokens=8),
                   paged_kv=PagedKVConfig(block_tokens=8, num_blocks=16),
@@ -123,6 +137,14 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
     else:
         kw.update(prefix_cache=PrefixCacheConfig(block_tokens=8, num_blocks=3))
     engine = ServingEngine(module, params, **kw)
+    if quant:
+        # the halved-block-bytes anchor: an int8 block (payload + fp32
+        # scale planes) must cost well under half its fp32 equivalent
+        c = module.config
+        h, d = c.n_head, c.n_embd // c.n_head
+        assert engine.kv_tier.block_bytes == c.n_layer * 2 * (8 * h * d
+                                                              + 8 * h * 4)
+        assert engine.kv_tier.block_bytes < c.n_layer * 2 * 8 * h * d * 4 / 2
     prompts = _prompts(17, [20, 24, 22, 20, 26, 24])
     prompts[3] = list(prompts[0])  # duplicate → prefix hit after donation
     for p in prompts:
@@ -149,7 +171,7 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
         assert (mem["block_pool/blocks_resident"] + spilled
                 == engine.prefix_cache.node_count())
         assert 0.0 <= mem["block_pool/fragmentation"] <= 1.0
-        if tier:
+        if tier != "plain":
             # cross-tier byte invariant, and the two host ledgers agree
             assert (mem["host_tier/bytes"]
                     == mem["host_tier/blocks"] * mem["host_tier/block_bytes"])
@@ -167,7 +189,7 @@ def test_occupancy_gauges_consistent_across_matrix(model, depth, admit, tier):
         check()
     mem = engine.memory_stats()
     assert mem["slots_active"] == 0 and mem["block_pool/blocks_pinned"] == 0
-    if tier:
+    if tier != "plain":
         assert mem["host_tier/hibernated"] == 0
         # force a spill of the drained trie's donations: the invariant must
         # hold with a genuinely non-zero host ledger, not just at 0 == 0

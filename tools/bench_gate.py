@@ -45,7 +45,7 @@ _DEFAULT_BEST = os.path.join(
 
 _LOWER_BETTER_HINTS = ("ttft", "itl", "latency", "blocked", "wall", "loss",
                        "compile", "dispatches_per_token",
-                       "forwards_per_accepted")
+                       "forwards_per_accepted", "kv_bytes_per_token")
 
 
 def lower_is_better(name: str, extra: tuple[str, ...] = ()) -> bool:

@@ -83,6 +83,11 @@ Env knobs:
                         to k+1 un-journaled accepted tokens per slot that
                         resume must replay exactly. Mutually exclusive with
                         CHAOS_SYNC_TOKENS > 1
+  CHAOS_QUANT           "int8" serves the crash scenario over int8 KV-cache
+                        storage (docs/serving.md "Quantized serving"): the
+                        parity oracle becomes the quantized solo generate,
+                        and resume must be crash-exact through
+                        re-quantization. Default "" = fp cache
   CHAOS_VERIFY_PARITY   1 (default) checks finished outputs against solo
                         generate; 0 skips the reference pass
   CHAOS_MESH            "DxM" (e.g. "2x2") replays through a mesh-sharded
@@ -1276,7 +1281,11 @@ def _crash_child() -> None:
     from accelerate_tpu.serving import PrefixCacheConfig, Request, ServingEngine
 
     n = _env_int("CHAOS_REQUESTS", 12)
-    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    quant = os.environ.get("CHAOS_QUANT", "")
+    cfg = GPT2Config.tiny(
+        dtype=jnp.float32,
+        kv_cache_dtype=jnp.int8 if quant == "int8" else None,
+    )
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0))
     trace = _trace(n, 1e9, _env_int("CHAOS_SEED", 0),
@@ -1556,11 +1565,15 @@ def run_crash(
     paged: bool = False,
     sync_tokens: int = 1,
     speculation: int = 0,
+    quant: str = "",
 ) -> dict:
     """Kill a child serving process mid-decode (SIGTERM or SIGKILL), resume a
     fresh engine from what survived on disk, and assert zero lost accepted
     requests plus zero token drift; return the summary dict (importable —
-    tests/test_serving_recovery.py runs it)."""
+    tests/test_serving_recovery.py runs it). ``quant="int8"`` runs the whole
+    scenario over int8 KV storage — the parity oracle becomes the quantized
+    solo generate, and the resume must be crash-exact through re-quantization
+    (prompt + replayed tokens land at the same positions -> same scales)."""
     import signal as _signal
     import subprocess
     import tempfile
@@ -1597,6 +1610,7 @@ def run_crash(
         CHAOS_PAGED=str(int(paged)),
         CHAOS_SYNC_TOKENS=str(sync_tokens),
         CHAOS_SPEC=str(speculation),
+        CHAOS_QUANT=quant,
         JAX_PLATFORMS="cpu",
     )
     t0 = time.perf_counter()
@@ -1641,7 +1655,13 @@ def run_crash(
     # replays the journal — nothing else survived
     source = (snapshot if scenario == "sigterm" and os.path.exists(snapshot)
               else journal)
-    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    # the resume (and the parity oracle below) must run the SAME quant mode
+    # the child served — generate over the int8-cache module IS the
+    # quantized-solo reference the streams are held to
+    cfg = GPT2Config.tiny(
+        dtype=jnp.float32,
+        kv_cache_dtype=jnp.int8 if quant == "int8" else None,
+    )
     module = GPT2LMHead(cfg)
     params = module.init_params(jax.random.key(0))
     tracer = Tracer() if trace_path else None
@@ -1730,6 +1750,7 @@ def run_crash(
             "paged_kv": bool(paged),
             "tokens_per_sync": sync_tokens,
             "speculation": speculation,
+            "quant": quant or None,
             "finished_pre_crash": len(scan.finishes),
             "resumed_mid_stream": len(report.resumed),
             "restored_queued": len(report.restored),
@@ -1836,6 +1857,7 @@ def main() -> None:
             paged=bool(_env_int("CHAOS_PAGED", 0)),
             sync_tokens=_env_int("CHAOS_SYNC_TOKENS", 1),
             speculation=_env_int("CHAOS_SPEC", 0),
+            quant=os.environ.get("CHAOS_QUANT", ""),
         )
         print(json.dumps(summary), flush=True)
         return

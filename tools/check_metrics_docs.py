@@ -51,12 +51,17 @@ def metric_families() -> list[str]:
     from accelerate_tpu.serving.anomaly import AnomalyMonitor
     from accelerate_tpu.serving.autoscaler import FleetAutoscaler
     from accelerate_tpu.serving.metrics import ServingMetrics
+    from accelerate_tpu.serving.telemetry import QUANT_GAUGES
 
     keys = set(ServingMetrics().snapshot())
     keys |= set(AnomalyMonitor().gauges())
     # the fleet autoscaler's gauges ride the cluster metrics view's snapshot
     # (serving/autoscaler.py — no live cluster needed, the names are static)
     keys |= set(FleetAutoscaler.GAUGES)
+    # quantized-serving gauges only exist on a quantized engine's points, so
+    # a fresh fp surface can't produce them — lint the static name list
+    # (serving/telemetry.QUANT_GAUGES, kept in sync with engine.quant_stats)
+    keys |= set(QUANT_GAUGES)
     families = set()
     for key in keys:
         dyn = next((p for p in _DYNAMIC_PREFIXES if key.startswith(p)), None)

@@ -196,8 +196,23 @@ def render(point: dict, history: list[dict] | None = None,
             f"{k.rsplit('/', 1)[-1]} {_human_bytes(v)}"
             for k, v in sorted(point.items())
             if k.startswith("serving/mem/slot_pool_bytes/"))
+        # quantized serving (serving/quant/* gauges, absent on fp engines):
+        # active KV storage dtype and weight-quant mode with the exact
+        # packed-vs-dense byte savings (docs/serving.md "Quantized serving")
+        quant = ""
+        kv_bits = g("serving/quant/kv_bits")
+        if kv_bits:
+            quant += f", kv int{int(kv_bits)}"
+        w_bits = g("serving/quant/weight_bits")
+        if w_bits:
+            mode = "int8" if int(w_bits) == 8 else "nf4"
+            quant += (f", weights {mode} "
+                      f"{_human_bytes(g('serving/quant/weight_packed_bytes', 0))}"
+                      f" (saves "
+                      f"{_human_bytes(g('serving/quant/weight_saved_bytes', 0))}"
+                      f" vs dense)")
         lines.append(f"kv     slot pool {_human_bytes(pool)}"
-                     + (f" ({by_dtype})" if by_dtype else ""))
+                     + (f" ({by_dtype})" if by_dtype else "") + quant)
     bt = g("serving/mem/block_pool/blocks_total")
     if bt:
         resident = g("serving/mem/block_pool/blocks_resident", 0)
